@@ -1,0 +1,136 @@
+"""Benchmark: full-batch partitioned GCN per-epoch wall-clock on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol mirrors the reference's (GPU/PGCN.py:202-228): 1 warm-up epoch, then
+timed epochs; epoch = full forward + backward + optimizer step over the whole
+graph. The synthetic workload is sized like ogbn-arxiv (169k vertices, ~1.2M
+undirected edges, 128 features, 3 layers), matching BASELINE.md config #2.
+
+``vs_baseline`` is the speedup of our jitted TPU epoch over the reference
+implementation style run on this host: a torch (CPU) ``torch.sparse.mm`` GCN
+epoch with identical shapes — the reference's own compute stack, since no
+NCCL/V100 cluster numbers are published in-repo (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+import scipy.sparse as sp
+
+
+def synth_graph(n: int, avg_deg: int, seed: int = 0) -> sp.csr_matrix:
+    """Random undirected graph with ~n*avg_deg/2 edges (power-law-free, fast)."""
+    rng = np.random.default_rng(seed)
+    m = n * avg_deg // 2
+    src = rng.integers(0, n, size=m)
+    dst = rng.integers(0, n, size=m)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    a = sp.coo_matrix((np.ones(len(src), np.float32), (src, dst)), shape=(n, n))
+    a = ((a + a.T) > 0).astype(np.float32)
+    return sp.csr_matrix(a)
+
+
+def bench_jax(ahat, feats, labels, widths, epochs: int) -> float:
+    import jax
+    from sgcn_tpu.parallel import build_comm_plan, make_mesh_1d
+    from sgcn_tpu.train import FullBatchTrainer, make_train_data
+    from sgcn_tpu.parallel.mesh import shard_stacked
+
+    k = len(jax.devices())
+    n = ahat.shape[0]
+    if k > 1:
+        from sgcn_tpu.partition import balanced_random_partition
+        pv = balanced_random_partition(n, k, seed=0)
+    else:
+        pv = np.zeros(n, dtype=np.int64)
+    plan = build_comm_plan(ahat, pv, k)
+    mesh = make_mesh_1d(k)
+    trainer = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths, mesh=mesh)
+    data = make_train_data(plan, feats, labels)
+    data = type(data)(**shard_stacked(mesh, vars(data)))
+    trainer.step(data)                       # warm-up (compile)
+    jax.block_until_ready(trainer.params)
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        trainer.step(data)
+    jax.block_until_ready(trainer.params)
+    return (time.perf_counter() - t0) / epochs
+
+
+def bench_torch_reference(ahat, feats, labels, widths, epochs: int) -> float:
+    """Reference-style torch implementation (sparse mm + Linear + ReLU),
+    same math as GPU/PGCN.py:136-148 on one process."""
+    import torch
+    import torch.nn.functional as F
+
+    coo = ahat.tocoo()
+    idx = torch.tensor(np.stack([coo.row, coo.col]), dtype=torch.long)
+    a = torch.sparse_coo_tensor(idx, torch.tensor(coo.data), coo.shape).coalesce()
+    h0 = torch.tensor(feats)
+    y = torch.tensor(labels, dtype=torch.long)
+    dims = list(zip([feats.shape[1]] + widths[:-1], widths))
+    ws = [torch.nn.Parameter(torch.empty(i, o)) for i, o in dims]
+    for w in ws:
+        torch.nn.init.xavier_uniform_(w)
+    opt = torch.optim.Adam(ws, lr=0.01)
+
+    def epoch():
+        opt.zero_grad()
+        h = h0
+        for i, w in enumerate(ws):
+            z = torch.sparse.mm(a, h) @ w
+            h = z if i == len(ws) - 1 else F.relu(z)
+        loss = F.cross_entropy(h, y)
+        loss.backward()
+        opt.step()
+
+    epoch()                                   # warm-up
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        epoch()
+    return (time.perf_counter() - t0) / epochs
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("-n", type=int, default=169_343)      # ogbn-arxiv scale
+    p.add_argument("--avg-deg", type=int, default=14)
+    p.add_argument("-f", type=int, default=128)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--classes", type=int, default=40)
+    p.add_argument("-l", "--layers", type=int, default=3)
+    p.add_argument("-e", "--epochs", type=int, default=5)
+    p.add_argument("--skip-torch", action="store_true")
+    args = p.parse_args()
+
+    from sgcn_tpu.prep import normalize_adjacency
+    a = synth_graph(args.n, args.avg_deg)
+    ahat = normalize_adjacency(a)
+    rng = np.random.default_rng(0)
+    feats = rng.standard_normal((args.n, args.f)).astype(np.float32)
+    labels = rng.integers(0, args.classes, size=args.n).astype(np.int32)
+    widths = [args.hidden] * (args.layers - 1) + [args.classes]
+
+    epoch_s = bench_jax(ahat, feats, labels, widths, args.epochs)
+    if args.skip_torch:
+        vs = 1.0
+    else:
+        ref_s = bench_torch_reference(ahat, feats, labels, widths,
+                                      max(2, args.epochs // 2))
+        vs = ref_s / epoch_s
+    print(json.dumps({
+        "metric": "fullbatch_gcn_epoch_time",
+        "value": round(epoch_s, 6),
+        "unit": "s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

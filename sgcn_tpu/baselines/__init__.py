@@ -1,0 +1,3 @@
+from .oracle import DenseOracle
+
+__all__ = ["DenseOracle"]

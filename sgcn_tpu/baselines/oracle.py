@@ -1,0 +1,75 @@
+"""Single-device dense oracle — ground truth for distributed parity tests.
+
+The reference's correctness story is empirical: the single-process DGL GCN
+(``DGL/gcn.py``) trained on the same preprocessed inputs is the ground truth
+the distributed runs are eyeballed against, and ``GPU/PGCN-Accuracy.py`` checks
+partitioned training does not change predictive performance (``README.md:110``).
+We make that an automated golden test: this oracle runs the *same* math as the
+distributed trainer (same init seed, same optimizer, same loss) on one device
+with a dense Â, and tests assert loss/logit/gradient parity to tolerance.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import scipy.sparse as sp
+
+from ..models.gcn import init_gcn_params
+
+_ACTS = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid, "none": lambda x: x}
+
+
+class DenseOracle:
+    """Single-device full-batch GCN with dense adjacency (DGL/gcn.py role)."""
+
+    def __init__(self, a: sp.spmatrix, fin: int, widths: list[int],
+                 lr: float = 0.01, activation: str = "relu",
+                 final_activation: str = "none",
+                 optimizer: optax.GradientTransformation | None = None,
+                 seed: int = 0):
+        self.a = jnp.asarray(sp.coo_matrix(a).todense(), dtype=jnp.float32)
+        dims = list(zip([fin] + widths[:-1], widths))
+        self.params = init_gcn_params(jax.random.PRNGKey(seed), dims)
+        self.opt = optimizer if optimizer is not None else optax.adam(lr)
+        self.opt_state = self.opt.init(self.params)
+        self.activation = activation
+        self.final_activation = final_activation
+        self._step = jax.jit(self._make_step())
+
+    def forward(self, params, h):
+        act, fact = _ACTS[self.activation], _ACTS[self.final_activation]
+        nl = len(params)
+        for i, w in enumerate(params):
+            z = (self.a @ h) @ w
+            h = fact(z) if i == nl - 1 else act(z)
+        return h
+
+    def loss(self, params, h, labels, mask):
+        logits = self.forward(params, h)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        return -(picked * mask).sum() / mask.sum()
+
+    def _make_step(self):
+        def step(params, opt_state, h, labels, mask):
+            loss, grads = jax.value_and_grad(self.loss)(params, h, labels, mask)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+        return step
+
+    def step(self, h, labels, mask=None) -> float:
+        h = jnp.asarray(h, jnp.float32)
+        labels = jnp.asarray(labels, jnp.int32)
+        mask = jnp.ones(h.shape[0]) if mask is None else jnp.asarray(mask, jnp.float32)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, h, labels, mask)
+        return float(loss)
+
+    def predict(self, h) -> np.ndarray:
+        return np.asarray(self.forward(self.params, jnp.asarray(h, jnp.float32)))
+
+    def fit(self, h, labels, mask=None, epochs: int = 5) -> list[float]:
+        return [self.step(h, labels, mask) for _ in range(epochs)]

@@ -1,0 +1,4 @@
+from .mtx import read_mtx, write_mtx
+from .config import ModelConfig, read_config, write_config
+
+__all__ = ["read_mtx", "write_mtx", "ModelConfig", "read_config", "write_config"]

@@ -1,0 +1,42 @@
+"""The ``config`` sidecar file: ``"nlayers nvtx f1 ... f_{L-1} nout"``.
+
+Format defined by the reference preprocessor (``preprocess/GrB-GNN-IDG.py:84-88``)
+and partitioner (``GCN-HP/main.cpp:117-131``), consumed by the trainers
+(``Parallel-GCN/main.c:687-714``).  Note the reference's quirk: ``nneurons[0]``
+is the vertex count and layer widths are offset by one; we store the semantic
+fields explicitly and can emit/parse the legacy line exactly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ModelConfig:
+    nlayers: int          # number of GCN layers
+    nvtx: int             # number of vertices (global)
+    widths: list[int] = field(default_factory=list)  # f1 ... f_{L-1}, nout
+
+    @property
+    def nout(self) -> int:
+        return self.widths[-1]
+
+    def layer_dims(self, fin: int) -> list[tuple[int, int]]:
+        """(in, out) dims per layer given the input feature width."""
+        dims = [fin] + list(self.widths)
+        return list(zip(dims[:-1], dims[1:]))
+
+
+def read_config(path: str) -> ModelConfig:
+    with open(path) as f:
+        toks = f.read().split()
+    nlayers, nvtx = int(toks[0]), int(toks[1])
+    widths = [int(t) for t in toks[2:]]
+    return ModelConfig(nlayers=nlayers, nvtx=nvtx, widths=widths)
+
+
+def write_config(path: str, cfg: ModelConfig) -> None:
+    toks = [str(cfg.nlayers), str(cfg.nvtx)] + [str(w) for w in cfg.widths]
+    with open(path, "w") as f:
+        f.write(" ".join(toks) + "\n")

@@ -1,0 +1,33 @@
+"""MatrixMarket coordinate I/O.
+
+The whole reference pipeline communicates through MatrixMarket files on disk
+(adjacency ``<name>.A.mtx``, features ``<name>.H.mtx``, labels ``<name>.Y.mtx``;
+see ``preprocess/GrB-GNN-IDG.py:80-88`` in the reference).  We use
+``scipy.io.mmread``-compatible semantics but keep our own thin reader/writer so
+that (a) pattern and symmetric files round-trip deterministically and (b) there
+is no dependency beyond scipy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.io
+import scipy.sparse as sp
+
+
+def read_mtx(path: str) -> sp.csr_matrix:
+    """Read a MatrixMarket file into CSR float32.
+
+    Symmetric / skew / pattern storage is expanded (mirrors the reference's
+    readers, which honor the symmetric qualifier — ``GCN-HP/main.cpp:366-405``).
+    Pattern files get all-ones values.
+    """
+    m = scipy.io.mmread(path)
+    m = sp.csr_matrix(m, dtype=np.float32)
+    m.sum_duplicates()
+    return m
+
+
+def write_mtx(path: str, m: sp.spmatrix, comment: str = "") -> None:
+    """Write CSR/COO to MatrixMarket coordinate general format (1-based)."""
+    scipy.io.mmwrite(path, sp.coo_matrix(m), comment=comment, precision=8)

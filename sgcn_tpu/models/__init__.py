@@ -1,0 +1,3 @@
+from .gcn import init_gcn_params, gcn_forward_local, masked_softmax_xent_local
+
+__all__ = ["init_gcn_params", "gcn_forward_local", "masked_softmax_xent_local"]

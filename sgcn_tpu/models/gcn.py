@@ -1,0 +1,89 @@
+"""Partitioned GCN model: per-chip layer stack over the pspmm op.
+
+Reference model being matched (capability, not quirk-for-quirk):
+
+  * ``PGCN(nn.Module)``: per layer, partitioned SpMM aggregation → bias-free
+    Linear → ReLU (``GPU/PGCN.py:136-148``), log-softmax + NLL loss
+    (``:204-205``), Glorot/averaged init (``:156-160``).
+  * MPI flavor uses sigmoid activations and BCE (``Parallel-GCN/main.c:79-90,
+    301-335``) — selectable here via ``activation='sigmoid'``.
+
+Per-chip code: every function below runs inside ``shard_map``; weights are
+replicated on every chip (the reference replicates W on every rank and
+all-reduces dW — ``Parallel-GCN/main.c:422-430``, ``GPU/PGCN.py:150-154``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.pspmm import pspmm_exchange
+from ..parallel.mesh import AXIS
+
+_ACTS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "none": lambda x: x,
+}
+
+
+def init_gcn_params(rng: jax.Array, dims: list[tuple[int, int]]):
+    """Glorot-uniform weight list, one (fin, fout) matrix per layer.
+
+    Reference init: Glorot uniform (``Parallel-GCN/main.c:584-594``); the
+    torch flavor synchronizes via an allreduce average (``GPU/PGCN.py:156-160``)
+    — here a shared seed makes every chip's copy identical by construction.
+    """
+    keys = jax.random.split(rng, len(dims))
+    return [
+        jax.nn.initializers.glorot_uniform()(k, (fin, fout), jnp.float32)
+        for k, (fin, fout) in zip(keys, dims)
+    ]
+
+
+def gcn_forward_local(
+    params,
+    h,                      # (B, f_in) local feature rows
+    send_idx, halo_src,     # halo-exchange plan (k, S) / (R,)
+    edge_dst, edge_src, edge_w,   # local padded edge lists (E,)
+    activation: str = "relu",
+    final_activation: str = "none",
+    axis_name: str = AXIS,
+):
+    """Per-chip forward: L × (pspmm → dense matmul → activation) → (B, nout)."""
+    act = _ACTS[activation]
+    fact = _ACTS[final_activation]
+    nl = len(params)
+    for i, w in enumerate(params):
+        ah = pspmm_exchange(h, send_idx, halo_src, edge_dst, edge_src, edge_w,
+                            axis_name=axis_name)
+        z = ah @ w
+        h = fact(z) if i == nl - 1 else act(z)
+    return h
+
+
+def masked_softmax_xent_local(logits, labels, valid, axis_name: str = AXIS):
+    """Global mean softmax cross-entropy over valid (non-padding) rows.
+
+    Per-chip sums are ``psum``-reduced so every chip holds the same scalar —
+    the analogue of the loss ``MPI_Reduce`` (``Parallel-GCN/main.c:318-323``)
+    and ``dist.all_reduce`` of the loss (``GPU/PGCN.py:223-224``), but exact:
+    a single global mean rather than a mean-of-per-rank-means.
+    """
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    local = -jnp.sum(picked * valid)
+    total = lax.psum(local, axis_name)
+    count = lax.psum(jnp.sum(valid), axis_name)
+    return total / count
+
+
+def masked_accuracy_local(logits, labels, valid, axis_name: str = AXIS):
+    """Global accuracy over valid rows (every chip gets the same scalar)."""
+    pred = jnp.argmax(logits, axis=-1)
+    hits = jnp.sum((pred == labels) * valid)
+    return lax.psum(hits, axis_name) / lax.psum(jnp.sum(valid), axis_name)

@@ -1,0 +1,3 @@
+from .pspmm import halo_exchange, spmm_local, pspmm, pspmm_exchange
+
+__all__ = ["halo_exchange", "spmm_local", "pspmm", "pspmm_exchange"]

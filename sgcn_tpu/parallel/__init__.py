@@ -1,0 +1,4 @@
+from .plan import CommPlan, build_comm_plan
+from .mesh import make_mesh_1d, shard_stacked, replicate
+
+__all__ = ["CommPlan", "build_comm_plan", "make_mesh_1d", "shard_stacked", "replicate"]

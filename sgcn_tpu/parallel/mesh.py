@@ -1,0 +1,34 @@
+"""Device mesh helpers for the 1D vertex-parallel layout.
+
+The reference's process topology is flat: k MPI ranks / k torch.distributed
+workers, one graph part each (``Parallel-GCN/main.c:101-103``,
+``GPU/PGCN.py:241-253``).  The TPU-native equivalent is a 1D
+``jax.sharding.Mesh`` over the chips with a single ``'v'`` (vertex) axis;
+per-chip arrays are stacked along a leading k axis and sharded with
+``PartitionSpec('v')``, replicated arrays use ``PartitionSpec()``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "v"
+
+
+def make_mesh_1d(k: int, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < k:
+        raise ValueError(f"need {k} devices, have {len(devices)}")
+    return Mesh(list(devices[:k]), (AXIS,))
+
+
+def shard_stacked(mesh: Mesh, tree):
+    """Place a pytree of (k, ...)-stacked arrays with the leading axis sharded."""
+    sh = NamedSharding(mesh, P(AXIS))
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def replicate(mesh: Mesh, tree):
+    sh = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)

@@ -1,0 +1,3 @@
+from .random_part import random_partition, balanced_random_partition
+
+__all__ = ["random_partition", "balanced_random_partition"]

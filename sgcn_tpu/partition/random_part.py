@@ -1,0 +1,22 @@
+"""Random partitioning — the reference's ``-r`` / ``.rp`` baseline flavor.
+
+Reference: ``GCN-HP/main.cpp:133-145`` (uniform random assignment) and
+``GPU/hypergraph/main.cpp:134-173`` (random with exact balance).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def random_partition(n: int, k: int, seed: int = 0) -> np.ndarray:
+    """Uniform iid random part vector (may be unbalanced, like ``-r``)."""
+    return np.random.default_rng(seed).integers(0, k, size=n).astype(np.int64)
+
+
+def balanced_random_partition(n: int, k: int, seed: int = 0) -> np.ndarray:
+    """Random permutation chopped into equal parts (exact balance)."""
+    perm = np.random.default_rng(seed).permutation(n)
+    part = np.empty(n, dtype=np.int64)
+    part[perm] = np.arange(n) % k
+    return part

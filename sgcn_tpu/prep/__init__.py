@@ -1,0 +1,3 @@
+from .normalize import normalize_adjacency, synthetic_features, synthetic_labels, preprocess
+
+__all__ = ["normalize_adjacency", "synthetic_features", "synthetic_labels", "preprocess"]

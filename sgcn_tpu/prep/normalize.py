@@ -1,0 +1,92 @@
+"""Preprocessing: adjacency normalization + synthetic feature/label generation.
+
+Capability parity with the reference input-data generator
+(``preprocess/GrB-GNN-IDG.py``): strip existing self-loops, add the identity,
+and symmetrically normalize ``Â = D_r^{-1/2} (A + I) D_c^{-1/2}``
+(reference ``:45-68``); emit an all-ones feature matrix (``:72-74``) and a
+2-column one-hot label matrix (``:76-78``); write ``<name>.{A,H,Y}.mtx`` plus
+the ``config`` sidecar (``:80-88``).
+
+Implementation is pure scipy/numpy (vectorized, no per-nnz Python loops).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..io.config import ModelConfig, write_config
+from ..io.mtx import write_mtx
+
+
+def normalize_adjacency(a: sp.spmatrix, add_self_loops: bool = True) -> sp.csr_matrix:
+    """``Â = D_r^{-1/2} (A + I) D_c^{-1/2}`` with prior self-loop stripping.
+
+    ``D_r`` / ``D_c`` are the row / column degree (nnz-count) matrices of
+    ``A + I`` — degree counts, not value sums, matching the reference which
+    normalizes by the number of incident edges.
+    """
+    a = sp.csr_matrix(a, dtype=np.float32)
+    a = a - sp.diags(a.diagonal())          # strip existing self-loops
+    a.eliminate_zeros()
+    if add_self_loops:
+        a = (a + sp.eye(a.shape[0], dtype=np.float32, format="csr")).tocsr()
+    coo = a.tocoo()
+    # degree = number of structural nonzeros per row / column
+    dr = np.bincount(coo.row, minlength=a.shape[0]).astype(np.float32)
+    dc = np.bincount(coo.col, minlength=a.shape[1]).astype(np.float32)
+    with np.errstate(divide="ignore"):
+        dri = np.where(dr > 0, 1.0 / np.sqrt(dr), 0.0).astype(np.float32)
+        dci = np.where(dc > 0, 1.0 / np.sqrt(dc), 0.0).astype(np.float32)
+    vals = coo.data * dri[coo.row] * dci[coo.col]
+    return sp.csr_matrix((vals, (coo.row, coo.col)), shape=a.shape)
+
+
+def synthetic_features(n: int, f: int = 1) -> sp.csr_matrix:
+    """All-ones n×f feature matrix (reference ``preprocess/GrB-GNN-IDG.py:72-74``)."""
+    return sp.csr_matrix(np.ones((n, f), dtype=np.float32))
+
+
+def synthetic_labels(n: int, nclasses: int = 2, seed: int = 0) -> sp.csr_matrix:
+    """One-hot n×nclasses label matrix with a deterministic class assignment.
+
+    The reference assigns each vertex one of two classes at random
+    (``preprocess/GrB-GNN-IDG.py:76-78``); we use a seeded RNG for
+    reproducibility.
+    """
+    rng = np.random.default_rng(seed)
+    cls = rng.integers(0, nclasses, size=n)
+    return sp.csr_matrix(
+        (np.ones(n, dtype=np.float32), (np.arange(n), cls)), shape=(n, nclasses)
+    )
+
+
+def preprocess(
+    a: sp.spmatrix,
+    out_dir: str,
+    name: str,
+    nlayers: int = 2,
+    hidden: int = 16,
+    nclasses: int = 2,
+    seed: int = 0,
+) -> ModelConfig:
+    """Full preprocessing pipeline: normalize, synthesize H/Y, write all artifacts.
+
+    Produces ``<name>.A.mtx``, ``<name>.H.mtx``, ``<name>.Y.mtx`` and ``config``
+    in ``out_dir`` — the file family every downstream stage of the reference
+    pipeline consumes (``preprocess/GrB-GNN-IDG.py:80-88``).
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    n = a.shape[0]
+    ahat = normalize_adjacency(a)
+    h = synthetic_features(n)
+    y = synthetic_labels(n, nclasses, seed)
+    write_mtx(os.path.join(out_dir, f"{name}.A.mtx"), ahat)
+    write_mtx(os.path.join(out_dir, f"{name}.H.mtx"), h)
+    write_mtx(os.path.join(out_dir, f"{name}.Y.mtx"), y)
+    widths = [hidden] * (nlayers - 1) + [nclasses]
+    cfg = ModelConfig(nlayers=nlayers, nvtx=n, widths=widths)
+    write_config(os.path.join(out_dir, "config"), cfg)
+    return cfg

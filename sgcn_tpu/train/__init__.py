@@ -1,0 +1,3 @@
+from .fullbatch import FullBatchTrainer, TrainData, make_train_data
+
+__all__ = ["FullBatchTrainer", "TrainData", "make_train_data"]

@@ -1,0 +1,4 @@
+from .stats import CommStats
+from .timers import PhaseTimer
+
+__all__ = ["CommStats", "PhaseTimer"]

@@ -1,0 +1,66 @@
+"""Communication statistics — the reference's fixed observability vocabulary.
+
+Both reference stacks count, per rank, ``send/recv_comm_volume`` (feature rows
+shipped) and ``send/recv_message_count``, then aggregate SUM and MAX across
+ranks into one end-of-run line (``Parallel-GCN/main.c:61-64,506-524``;
+``GPU/PGCN.py:78-83,230-238``).
+
+Under the static all_to_all plan the per-exchange volume is known exactly at
+plan time (it equals the plan's predicted connectivity volume — the invariant
+the reference checks empirically), so counters advance deterministically per
+step instead of being tallied inside the hot loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class CommStats:
+    k: int
+    send_volume_per_exchange: np.ndarray   # (k,) boundary rows per halo exchange
+    send_msgs_per_exchange: np.ndarray     # (k,) non-empty peer messages
+    recv_volume_per_exchange: np.ndarray   # (k,)
+    recv_msgs_per_exchange: np.ndarray     # (k,)
+    exchanges: int = 0                     # cumulative halo exchanges performed
+
+    @classmethod
+    def from_plan(cls, plan) -> "CommStats":
+        off = plan.send_counts.astype(np.int64).copy()
+        np.fill_diagonal(off, 0)
+        return cls(
+            k=plan.k,
+            send_volume_per_exchange=plan.predicted_send_volume.astype(np.int64),
+            send_msgs_per_exchange=plan.predicted_message_count.astype(np.int64),
+            recv_volume_per_exchange=off.sum(axis=0),
+            recv_msgs_per_exchange=(off > 0).sum(axis=0),
+        )
+
+    def count_step(self, nlayers: int) -> None:
+        """One training step = nlayers forward + nlayers backward exchanges
+        (the backward halo exchange mirrors the forward —
+        ``Parallel-GCN/main.c:340-372``)."""
+        self.exchanges += 2 * nlayers
+
+    def count_forward(self, nlayers: int) -> None:
+        self.exchanges += nlayers
+
+    def report(self) -> dict:
+        sv = self.send_volume_per_exchange * self.exchanges
+        sm = self.send_msgs_per_exchange * self.exchanges
+        rv = self.recv_volume_per_exchange * self.exchanges
+        rm = self.recv_msgs_per_exchange * self.exchanges
+        # the reference's 8-number line: SUM and MAX over ranks of each counter
+        return {
+            "total_send_volume": int(sv.sum()),
+            "max_send_volume": int(sv.max()) if self.k else 0,
+            "total_send_msgs": int(sm.sum()),
+            "max_send_msgs": int(sm.max()) if self.k else 0,
+            "total_recv_volume": int(rv.sum()),
+            "max_recv_volume": int(rv.max()) if self.k else 0,
+            "total_recv_msgs": int(rm.sum()),
+            "max_recv_msgs": int(rm.max()) if self.k else 0,
+        }

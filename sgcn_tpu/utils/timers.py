@@ -1,0 +1,50 @@
+"""Phase timers — the CAGNET baseline's phase-time breakdown, generalized.
+
+The reference accumulates ``data_comm / local_spmm / all_reduce / local_update``
+wall-clock per phase (``Cagnet/main.c:35-38,148-151,171-175,395-413``).  Under
+jit whole steps fuse into one program, so phase timing is host-side around
+block_until_ready boundaries; for intra-step attribution use
+``jax.profiler.trace`` (exposed via ``trace()``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+
+class PhaseTimer:
+    def __init__(self):
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, sync=None):
+        """Time a phase. ``sync`` is a zero-arg callable returning the arrays to
+        block on (evaluated after the body, so it sees post-body values —
+        passing a value directly would capture stale pre-body buffers)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                jax.block_until_ready(sync())
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> dict:
+        return {
+            name: {"total_s": self.totals[name], "count": self.counts[name],
+                   "avg_s": self.totals[name] / max(self.counts[name], 1)}
+            for name in self.totals
+        }
+
+    @staticmethod
+    @contextlib.contextmanager
+    def trace(logdir: str):
+        """Full XLA profiler trace (TensorBoard-viewable)."""
+        with jax.profiler.trace(logdir):
+            yield

@@ -1,0 +1,47 @@
+"""Test harness: run every collective on 8 virtual CPU devices.
+
+The reference's de-facto test mode is "cluster on one box": the Gloo backend
+puts each rank on ``cpu:<rank>`` (``GPU/PGCN.py:166-169``) so the full
+distributed algorithm runs multi-process on one host.  Our equivalent is
+multi-device CPU JAX: 8 host platform devices, so every shard_map /
+all_to_all / psum in the suite executes a real collective without TPUs.
+
+This must run before JAX initializes a backend, hence top of conftest.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import scipy.sparse as sp  # noqa: E402
+
+
+def er_graph(n: int = 48, p: float = 0.15, seed: int = 1) -> sp.csr_matrix:
+    """Symmetric Erdős–Rényi graph, no self-loops, float32."""
+    rng = np.random.default_rng(seed)
+    dense = rng.random((n, n)) < p
+    dense = np.triu(dense, 1)
+    dense = dense | dense.T
+    return sp.csr_matrix(dense.astype(np.float32))
+
+
+@pytest.fixture(scope="session")
+def graph():
+    return er_graph()
+
+
+@pytest.fixture(scope="session")
+def ahat(graph):
+    from sgcn_tpu.prep import normalize_adjacency
+    return normalize_adjacency(graph)

@@ -1,0 +1,64 @@
+"""I/O and preprocessing tests (reference: preprocess/GrB-GNN-IDG.py)."""
+
+import numpy as np
+import scipy.sparse as sp
+
+from sgcn_tpu.io import ModelConfig, read_config, read_mtx, write_config, write_mtx
+from sgcn_tpu.prep import normalize_adjacency, preprocess, synthetic_features, synthetic_labels
+
+
+def test_mtx_roundtrip(tmp_path, graph):
+    p = str(tmp_path / "g.mtx")
+    write_mtx(p, graph)
+    back = read_mtx(p)
+    assert (back != graph).nnz == 0
+
+
+def test_config_roundtrip(tmp_path):
+    cfg = ModelConfig(nlayers=3, nvtx=100, widths=[16, 16, 4])
+    p = str(tmp_path / "config")
+    write_config(p, cfg)
+    back = read_config(p)
+    assert back == cfg
+    assert back.nout == 4
+    assert back.layer_dims(8) == [(8, 16), (16, 16), (16, 4)]
+
+
+def test_normalize_golden():
+    # path graph 0-1-2: A+I degrees are [2,3,2] on rows and cols.
+    a = sp.csr_matrix(np.array([[0, 1, 0], [1, 0, 1], [0, 1, 0]], dtype=np.float32))
+    ah = normalize_adjacency(a).toarray()
+    d = np.array([2.0, 3.0, 2.0])
+    expected = (np.array([[1, 1, 0], [1, 1, 1], [0, 1, 1]], dtype=np.float32)
+                / np.sqrt(d)[:, None] / np.sqrt(d)[None, :])
+    np.testing.assert_allclose(ah, expected, rtol=1e-6)
+
+
+def test_normalize_strips_and_adds_self_loops():
+    # existing self-loop must be stripped then identity re-added exactly once
+    a = sp.csr_matrix(np.array([[5, 1], [1, 0]], dtype=np.float32))
+    ah = normalize_adjacency(a).toarray()
+    # degrees of (A-diag+I): each row/col has 2 nnz
+    np.testing.assert_allclose(ah, np.full((2, 2), 0.5), rtol=1e-6)
+
+
+def test_preprocess_outputs(tmp_path, graph):
+    cfg = preprocess(graph, str(tmp_path), "er", nlayers=2, hidden=8, nclasses=3)
+    assert cfg.nvtx == graph.shape[0]
+    assert cfg.widths == [8, 3]
+    a = read_mtx(str(tmp_path / "er.A.mtx"))
+    h = read_mtx(str(tmp_path / "er.H.mtx"))
+    y = read_mtx(str(tmp_path / "er.Y.mtx"))
+    assert a.shape == graph.shape
+    assert (a.diagonal() > 0).all()          # self-loops present
+    assert h.shape[0] == cfg.nvtx and (h.toarray() == 1).all()
+    assert y.shape == (cfg.nvtx, 3)
+    np.testing.assert_array_equal(np.asarray(y.sum(axis=1)).ravel(), 1.0)
+    assert read_config(str(tmp_path / "config")) == cfg
+
+
+def test_synthetic_shapes():
+    h = synthetic_features(10, 4)
+    y = synthetic_labels(10, 2, seed=3)
+    assert h.shape == (10, 4)
+    assert y.shape == (10, 2)

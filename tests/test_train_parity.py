@@ -1,0 +1,73 @@
+"""Distributed-vs-single-device training parity — the automated form of the
+reference's accuracy-parity experiment (GPU/PGCN-Accuracy.py, README.md:110)
+with the dense oracle in the DGL/gcn.py role."""
+
+import numpy as np
+import pytest
+
+from sgcn_tpu.baselines import DenseOracle
+from sgcn_tpu.parallel import build_comm_plan
+from sgcn_tpu.partition import balanced_random_partition
+from sgcn_tpu.train import FullBatchTrainer, make_train_data
+
+
+def _dataset(ahat, f=6, c=3, seed=9):
+    n = ahat.shape[0]
+    rng = np.random.default_rng(seed)
+    feats = rng.standard_normal((n, f)).astype(np.float32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+    return feats, labels
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_loss_parity_with_oracle(ahat, k):
+    n = ahat.shape[0]
+    feats, labels = _dataset(ahat)
+    widths = [8, 3]
+    pv = balanced_random_partition(n, k, seed=21)
+    plan = build_comm_plan(ahat, pv, k)
+    trainer = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths, seed=42)
+    data = make_train_data(plan, feats, labels)
+    oracle = DenseOracle(ahat, fin=feats.shape[1], widths=widths, seed=42)
+
+    dist_losses = [trainer.step(data) for _ in range(6)]
+    oracle_losses = oracle.fit(feats, labels, epochs=6)
+    np.testing.assert_allclose(dist_losses, oracle_losses, rtol=2e-4, atol=1e-5)
+
+    got = trainer.predict(data)
+    expected = oracle.predict(feats)
+    np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-4)
+
+
+def test_eval_and_accuracy(ahat):
+    n = ahat.shape[0]
+    feats, labels = _dataset(ahat)
+    pv = balanced_random_partition(n, 4, seed=22)
+    plan = build_comm_plan(ahat, pv, 4)
+    trainer = FullBatchTrainer(plan, fin=feats.shape[1], widths=[8, 3], seed=1)
+    mask = (np.arange(n) % 2 == 0).astype(np.float32)   # train/eval split
+    data = make_train_data(plan, feats, labels, train_mask=mask,
+                           eval_mask=1.0 - mask)
+    for _ in range(3):
+        trainer.step(data)
+    loss, acc = trainer.evaluate(data)
+    assert np.isfinite(loss)
+    assert 0.0 <= acc <= 1.0
+
+
+def test_fit_reports_reference_stats(ahat):
+    n = ahat.shape[0]
+    feats, labels = _dataset(ahat)
+    pv = balanced_random_partition(n, 4, seed=23)
+    plan = build_comm_plan(ahat, pv, 4)
+    trainer = FullBatchTrainer(plan, fin=feats.shape[1], widths=[8, 3])
+    data = make_train_data(plan, feats, labels)
+    report = trainer.fit(data, epochs=2, warmup=1, verbose=False)
+    # 3 steps × 2 layers × fwd+bwd exchanges
+    assert trainer.stats.exchanges == 3 * 2 * 2
+    expected_vol = plan.predicted_send_volume.sum() * trainer.stats.exchanges
+    assert report["total_send_volume"] == expected_vol
+    assert report["epochs"] == 2 and report["epoch_s"] > 0
+    assert len(report["loss_history"]) == 2
+    # loss should be decreasing on this easy overfit task
+    assert report["loss_history"][-1] < report["loss_history"][0] * 1.5
