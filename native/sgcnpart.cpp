@@ -1,0 +1,742 @@
+// sgcnpart — multilevel k-way graph and column-net hypergraph partitioners.
+//
+// TPU-era replacement for the capabilities the reference gets from vendored
+// METIS (GCN-GP/main.cpp:290-348, METIS_PartGraphKway, edge-cut objective) and
+// PaToH/KaHyPar (GCN-HP/main.cpp:284-356, column-net model, connectivity-1
+// objective).  We cannot redistribute those libraries, so this is our own
+// implementation of the same algorithm family:
+//
+//   graph:      heavy-edge-matching coarsening -> greedy k-way growing on the
+//               coarsest graph -> greedy boundary refinement on each level
+//               (edge-cut objective, balance constraint).
+//   hypergraph: heavy-connectivity matching on cells -> greedy growing ->
+//               boundary FM-style km1 refinement with per-net part-pin counts
+//               (connectivity-1 objective; cells = matrix rows weighted by
+//               nnz, nets = columns — the column-net model of the reference).
+//
+// Exposed as a C ABI for ctypes (sgcn_tpu/partition/native.py) and as a small
+// CLI (main() at the bottom) mirroring the reference partitioner executables.
+//
+// Quality bar (SURVEY.md §7.1): self-reported cut / lambda-1 must beat random
+// partitioning by a wide margin and respect the balance constraint; bit-parity
+// with METIS/PaToH is a non-goal.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <numeric>
+#include <random>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using i32 = int32_t;
+using i64 = int64_t;
+
+struct Graph {
+  i32 n = 0;
+  std::vector<i64> xadj;    // n+1
+  std::vector<i32> adj;     // neighbor ids
+  std::vector<float> wgt;   // edge weights
+  std::vector<i64> vwgt;    // vertex weights
+  i64 total_vwgt = 0;
+};
+
+// ---------------------------------------------------------------- coarsening
+struct MatchResult {
+  std::vector<i32> cmap;    // fine vertex -> coarse vertex
+  i32 cn = 0;
+};
+
+MatchResult heavy_edge_matching(const Graph& g, std::mt19937& rng) {
+  std::vector<i32> order(g.n);
+  std::iota(order.begin(), order.end(), 0);
+  std::shuffle(order.begin(), order.end(), rng);
+  std::vector<i32> match(g.n, -1);
+  for (i32 v : order) {
+    if (match[v] != -1) continue;
+    i32 best = -1;
+    float best_w = -1.0f;
+    for (i64 e = g.xadj[v]; e < g.xadj[v + 1]; ++e) {
+      i32 u = g.adj[e];
+      if (u == v || match[u] != -1) continue;
+      if (g.wgt[e] > best_w) { best_w = g.wgt[e]; best = u; }
+    }
+    if (best != -1) { match[v] = best; match[best] = v; }
+    else match[v] = v;
+  }
+  MatchResult r;
+  r.cmap.assign(g.n, -1);
+  for (i32 v = 0; v < g.n; ++v) {
+    if (r.cmap[v] != -1) continue;
+    i32 u = match[v];
+    r.cmap[v] = r.cn;
+    if (u != v && u != -1) r.cmap[u] = r.cn;
+    ++r.cn;
+  }
+  return r;
+}
+
+Graph contract(const Graph& g, const MatchResult& m) {
+  Graph c;
+  c.n = m.cn;
+  c.vwgt.assign(m.cn, 0);
+  for (i32 v = 0; v < g.n; ++v) c.vwgt[m.cmap[v]] += g.vwgt[v];
+  c.total_vwgt = g.total_vwgt;
+  c.xadj.assign(m.cn + 1, 0);
+  // bucket fine vertices by coarse id
+  std::vector<i32> fine_of(g.n);
+  std::vector<i64> cstart(m.cn + 1, 0);
+  for (i32 v = 0; v < g.n; ++v) cstart[m.cmap[v] + 1]++;
+  for (i32 cv = 0; cv < m.cn; ++cv) cstart[cv + 1] += cstart[cv];
+  {
+    std::vector<i64> pos(cstart.begin(), cstart.end() - 1);
+    for (i32 v = 0; v < g.n; ++v) fine_of[pos[m.cmap[v]]++] = v;
+  }
+  std::unordered_map<i32, float> nbr;
+  nbr.reserve(256);
+  for (i32 cv = 0; cv < m.cn; ++cv) {
+    nbr.clear();
+    for (i64 p = cstart[cv]; p < cstart[cv + 1]; ++p) {
+      i32 v = fine_of[p];
+      for (i64 e = g.xadj[v]; e < g.xadj[v + 1]; ++e) {
+        i32 cu = m.cmap[g.adj[e]];
+        if (cu == cv) continue;
+        nbr[cu] += g.wgt[e];
+      }
+    }
+    c.xadj[cv + 1] = c.xadj[cv] + (i64)nbr.size();
+    for (auto& kv : nbr) { c.adj.push_back(kv.first); c.wgt.push_back(kv.second); }
+  }
+  return c;
+}
+
+// ------------------------------------------------------- initial partitioning
+// Greedy k-way growing: spread seeds, grow parts by absorbing the frontier
+// vertex with the strongest connection to the part, under the balance cap.
+void greedy_grow(const Graph& g, int k, double cap, std::vector<i32>& part,
+                 std::mt19937& rng) {
+  part.assign(g.n, -1);
+  std::vector<i64> pw(k, 0);
+  std::vector<float> conn(g.n, 0.0f);   // connection of v to the growing part
+  std::vector<i32> order(g.n);
+  std::iota(order.begin(), order.end(), 0);
+  std::shuffle(order.begin(), order.end(), rng);
+  size_t cursor = 0;
+  for (int p = 0; p < k; ++p) {
+    // seed: first unassigned vertex in the shuffled order
+    while (cursor < order.size() && part[order[cursor]] != -1) ++cursor;
+    if (cursor >= order.size()) break;
+    i32 seed = order[cursor];
+    std::fill(conn.begin(), conn.end(), 0.0f);
+    std::vector<i32> frontier{seed};
+    part[seed] = p; pw[p] += g.vwgt[seed];
+    // grow until this part reaches total/k (leave slack for the last parts)
+    i64 target = g.total_vwgt / k;
+    while (pw[p] < target) {
+      // refresh connections from newly absorbed vertices
+      for (i32 v : frontier)
+        for (i64 e = g.xadj[v]; e < g.xadj[v + 1]; ++e) {
+          i32 u = g.adj[e];
+          if (part[u] == -1) conn[u] += g.wgt[e];
+        }
+      frontier.clear();
+      // pick best-connected unassigned vertex (linear scan; coarsest graph is small)
+      i32 best = -1; float best_c = -1.0f;
+      for (i32 u = 0; u < g.n; ++u)
+        if (part[u] == -1 && conn[u] > best_c) { best_c = conn[u]; best = u; }
+      if (best == -1 || best_c <= 0.0f) {
+        // disconnected: jump to any unassigned vertex
+        for (i32 u = 0; u < g.n; ++u) if (part[u] == -1) { best = u; break; }
+        if (best == -1) break;
+      }
+      if (pw[p] + g.vwgt[best] > (i64)(cap)) break;
+      part[best] = p; pw[p] += g.vwgt[best];
+      frontier.push_back(best);
+    }
+  }
+  // leftovers -> lightest part
+  for (i32 v = 0; v < g.n; ++v)
+    if (part[v] == -1) {
+      int lp = (int)(std::min_element(pw.begin(), pw.end()) - pw.begin());
+      part[v] = lp; pw[lp] += g.vwgt[v];
+    }
+}
+
+// ------------------------------------------------------------- refinement
+// Greedy boundary passes: move a vertex to the neighboring part with the best
+// positive cut gain if balance allows. (The default refinement of the METIS
+// family is this same greedy variant of KL/FM.)
+void refine_cut(const Graph& g, int k, double cap, std::vector<i32>& part,
+                int max_passes) {
+  std::vector<i64> pw(k, 0);
+  for (i32 v = 0; v < g.n; ++v) pw[part[v]] += g.vwgt[v];
+  std::vector<float> gain(k);
+  for (int pass = 0; pass < max_passes; ++pass) {
+    i64 moves = 0;
+    for (i32 v = 0; v < g.n; ++v) {
+      int pv = part[v];
+      bool boundary = false;
+      for (i64 e = g.xadj[v]; e < g.xadj[v + 1] && !boundary; ++e)
+        boundary = part[g.adj[e]] != pv;
+      if (!boundary) continue;
+      std::fill(gain.begin(), gain.end(), 0.0f);
+      for (i64 e = g.xadj[v]; e < g.xadj[v + 1]; ++e)
+        gain[part[g.adj[e]]] += g.wgt[e];
+      int best = pv; float best_gain = 0.0f;
+      for (int p = 0; p < k; ++p) {
+        if (p == pv) continue;
+        float d = gain[p] - gain[pv];
+        if (d > best_gain && pw[p] + g.vwgt[v] <= (i64)cap) {
+          best_gain = d; best = p;
+        }
+      }
+      if (best != pv) {
+        pw[pv] -= g.vwgt[v]; pw[best] += g.vwgt[v];
+        part[v] = best; ++moves;
+      }
+    }
+    if (moves == 0) break;
+  }
+}
+
+i64 edge_cut(const Graph& g, const std::vector<i32>& part) {
+  double cut = 0;
+  for (i32 v = 0; v < g.n; ++v)
+    for (i64 e = g.xadj[v]; e < g.xadj[v + 1]; ++e)
+      if (part[v] != part[g.adj[e]]) cut += g.wgt[e];
+  return (i64)(cut / 2.0 + 0.5);
+}
+
+// ------------------------------------------------------------ multilevel driver
+void partition_graph_ml(const Graph& g0, int k, double imbalance, int seed,
+                        std::vector<i32>& part) {
+  std::mt19937 rng(seed);
+  std::vector<Graph> levels;
+  std::vector<MatchResult> maps;
+  levels.push_back(g0);
+  const i32 coarse_target = std::max(64, 24 * k);
+  while (levels.back().n > coarse_target) {
+    MatchResult m = heavy_edge_matching(levels.back(), rng);
+    if (m.cn > (i32)(0.97 * levels.back().n)) break;   // matching stalled
+    Graph c = contract(levels.back(), m);
+    maps.push_back(std::move(m));
+    levels.push_back(std::move(c));
+  }
+  double cap = (1.0 + imbalance) * (double)g0.total_vwgt / k;
+  greedy_grow(levels.back(), k, cap, part, rng);
+  refine_cut(levels.back(), k, cap, part, 10);
+  // project back up with refinement at each level
+  for (int li = (int)levels.size() - 2; li >= 0; --li) {
+    const MatchResult& m = maps[li];
+    std::vector<i32> fine(levels[li].n);
+    for (i32 v = 0; v < levels[li].n; ++v) fine[v] = part[m.cmap[v]];
+    part = std::move(fine);
+    refine_cut(levels[li], k, cap, part, li == 0 ? 8 : 4);
+  }
+}
+
+// ======================================================= hypergraph (colnet)
+struct Hypergraph {
+  i32 ncells = 0, nnets = 0;
+  std::vector<i64> cellptr;   // cell -> nets
+  std::vector<i32> cellnets;
+  std::vector<i64> netptr;    // net -> pins(cells)
+  std::vector<i32> netpins;
+  std::vector<i64> cwgt;      // cell weights
+  i64 total_cwgt = 0;
+};
+
+Hypergraph from_cells(i32 ncells, i32 nnets, const i64* cellptr,
+                      const i32* cellnets, const i64* cwgt) {
+  Hypergraph h;
+  h.ncells = ncells; h.nnets = nnets;
+  h.cellptr.assign(cellptr, cellptr + ncells + 1);
+  h.cellnets.assign(cellnets, cellnets + cellptr[ncells]);
+  h.cwgt.assign(ncells, 1);
+  if (cwgt) h.cwgt.assign(cwgt, cwgt + ncells);
+  h.total_cwgt = std::accumulate(h.cwgt.begin(), h.cwgt.end(), (i64)0);
+  // invert to net -> pins
+  h.netptr.assign(nnets + 1, 0);
+  for (i64 e = 0; e < (i64)h.cellnets.size(); ++e) h.netptr[h.cellnets[e] + 1]++;
+  for (i32 j = 0; j < nnets; ++j) h.netptr[j + 1] += h.netptr[j];
+  h.netpins.resize(h.cellnets.size());
+  std::vector<i64> pos(h.netptr.begin(), h.netptr.end() - 1);
+  for (i32 c = 0; c < ncells; ++c)
+    for (i64 e = h.cellptr[c]; e < h.cellptr[c + 1]; ++e)
+      h.netpins[pos[h.cellnets[e]]++] = c;
+  return h;
+}
+
+// heavy-connectivity matching: match cells sharing the most nets
+MatchResult hc_matching(const Hypergraph& h, std::mt19937& rng,
+                        i64 big_net_threshold) {
+  std::vector<i32> order(h.ncells);
+  std::iota(order.begin(), order.end(), 0);
+  std::shuffle(order.begin(), order.end(), rng);
+  std::vector<i32> match(h.ncells, -1);
+  std::unordered_map<i32, i32> shared;
+  shared.reserve(512);
+  for (i32 v : order) {
+    if (match[v] != -1) continue;
+    shared.clear();
+    for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
+      i32 net = h.cellnets[e];
+      i64 deg = h.netptr[net + 1] - h.netptr[net];
+      if (deg > big_net_threshold) continue;        // skip huge nets (cost)
+      for (i64 p = h.netptr[net]; p < h.netptr[net + 1]; ++p) {
+        i32 u = h.netpins[p];
+        if (u != v && match[u] == -1) shared[u]++;
+      }
+    }
+    i32 best = -1, best_s = 0;
+    for (auto& kv : shared)
+      if (kv.second > best_s) { best_s = kv.second; best = kv.first; }
+    if (best != -1) { match[v] = best; match[best] = v; }
+    else match[v] = v;
+  }
+  MatchResult r;
+  r.cmap.assign(h.ncells, -1);
+  for (i32 v = 0; v < h.ncells; ++v) {
+    if (r.cmap[v] != -1) continue;
+    i32 u = match[v];
+    r.cmap[v] = r.cn;
+    if (u != v && u != -1) r.cmap[u] = r.cn;
+    ++r.cn;
+  }
+  return r;
+}
+
+Hypergraph contract_h(const Hypergraph& h, const MatchResult& m) {
+  Hypergraph c;
+  c.ncells = m.cn; c.nnets = h.nnets;
+  c.cwgt.assign(m.cn, 0);
+  for (i32 v = 0; v < h.ncells; ++v) c.cwgt[m.cmap[v]] += h.cwgt[v];
+  c.total_cwgt = h.total_cwgt;
+  // coarse cell -> dedup'd union of nets
+  std::vector<std::vector<i32>> nets(m.cn);
+  for (i32 v = 0; v < h.ncells; ++v) {
+    auto& dst = nets[m.cmap[v]];
+    for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e)
+      dst.push_back(h.cellnets[e]);
+  }
+  c.cellptr.assign(m.cn + 1, 0);
+  for (i32 cv = 0; cv < m.cn; ++cv) {
+    auto& d = nets[cv];
+    std::sort(d.begin(), d.end());
+    d.erase(std::unique(d.begin(), d.end()), d.end());
+    c.cellptr[cv + 1] = c.cellptr[cv] + (i64)d.size();
+  }
+  c.cellnets.reserve(c.cellptr[m.cn]);
+  for (i32 cv = 0; cv < m.cn; ++cv)
+    c.cellnets.insert(c.cellnets.end(), nets[cv].begin(), nets[cv].end());
+  // rebuild net -> pins (drop single-pin nets? keep, harmless)
+  c.netptr.assign(c.nnets + 1, 0);
+  for (i32 x : c.cellnets) c.netptr[x + 1]++;
+  for (i32 j = 0; j < c.nnets; ++j) c.netptr[j + 1] += c.netptr[j];
+  c.netpins.resize(c.cellnets.size());
+  std::vector<i64> pos(c.netptr.begin(), c.netptr.end() - 1);
+  for (i32 cv = 0; cv < m.cn; ++cv)
+    for (i64 e = c.cellptr[cv]; e < c.cellptr[cv + 1]; ++e)
+      c.netpins[pos[c.cellnets[e]]++] = cv;
+  return c;
+}
+
+// km1 objective helpers: per-net pin counts per part (dense nnets × k)
+struct PinCounts {
+  std::vector<i32> cnt;   // nnets * k
+  int k;
+  i32* row(i32 net) { return cnt.data() + (i64)net * k; }
+};
+
+i64 km1_total(const Hypergraph& h, PinCounts& pc) {
+  i64 s = 0;
+  for (i32 j = 0; j < h.nnets; ++j) {
+    i32* r = pc.row(j);
+    int lambda = 0;
+    for (int p = 0; p < pc.k; ++p) lambda += r[p] > 0;
+    if (lambda > 1) s += lambda - 1;
+  }
+  return s;
+}
+
+void build_pincounts(const Hypergraph& h, const std::vector<i32>& part,
+                     PinCounts& pc) {
+  pc.cnt.assign((i64)h.nnets * pc.k, 0);
+  for (i32 j = 0; j < h.nnets; ++j) {
+    i32* r = pc.row(j);
+    for (i64 p = h.netptr[j]; p < h.netptr[j + 1]; ++p) r[part[h.netpins[p]]]++;
+  }
+}
+
+// Connectivity-aware greedy placement on the coarsest hypergraph: cells are
+// placed in random order into the part their nets already touch most, under
+// the balance cap (constructive form of the km1 gain).
+void greedy_grow_h(const Hypergraph& h, int k, double cap,
+                   std::vector<i32>& part, std::mt19937& rng) {
+  part.assign(h.ncells, -1);
+  std::vector<i32> order(h.ncells);
+  std::iota(order.begin(), order.end(), 0);
+  std::shuffle(order.begin(), order.end(), rng);
+  std::vector<i64> pw(k, 0);
+  // net -> set of parts present, tracked as dense counts
+  std::vector<i32> netpart((i64)h.nnets * k, 0);
+  std::vector<i64> affinity(k);
+  for (i32 idx = 0; idx < h.ncells; ++idx) {
+    i32 v = order[idx];
+    std::fill(affinity.begin(), affinity.end(), 0);
+    for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
+      const i32* r = netpart.data() + (i64)h.cellnets[e] * k;
+      for (int p = 0; p < k; ++p) affinity[p] += r[p] > 0;
+    }
+    int best = -1; i64 best_a = -1;
+    for (int p = 0; p < k; ++p)
+      if (pw[p] + h.cwgt[v] <= (i64)cap && affinity[p] > best_a) {
+        best_a = affinity[p]; best = p;
+      }
+    if (best == -1)   // everything full (rounding): lightest part
+      best = (int)(std::min_element(pw.begin(), pw.end()) - pw.begin());
+    part[v] = best; pw[best] += h.cwgt[v];
+    for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e)
+      netpart[(i64)h.cellnets[e] * k + best]++;
+  }
+}
+
+// boundary FM-style passes on km1 with dense pin counts
+void refine_km1(const Hypergraph& h, int k, double cap, std::vector<i32>& part,
+                int max_passes) {
+  PinCounts pc; pc.k = k;
+  build_pincounts(h, part, pc);
+  std::vector<i64> pw(k, 0);
+  for (i32 v = 0; v < h.ncells; ++v) pw[part[v]] += h.cwgt[v];
+  std::vector<i32> gain(k);
+  for (int pass = 0; pass < max_passes; ++pass) {
+    i64 moves = 0;
+    for (i32 v = 0; v < h.ncells; ++v) {
+      int pv = part[v];
+      // km1 gain of moving v from pv to p:
+      //   + for each net where v is pv's last pin (leaving removes pv from net)
+      //   - for each net where p has no pin yet (arriving adds p to net)
+      std::fill(gain.begin(), gain.end(), 0);
+      int leave_bonus = 0;
+      bool boundary = false;
+      for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
+        i32* r = pc.row(h.cellnets[e]);
+        if (r[pv] == 1) leave_bonus++;
+        for (int p = 0; p < k; ++p)
+          if (p != pv && r[p] > 0) { gain[p]++; boundary = true; }
+      }
+      if (!boundary) continue;
+      // gain[p] currently counts nets where p already present; real gain:
+      //   leave_bonus - (#nets of v where p absent)
+      //   = leave_bonus - (deg(v) - gain[p])
+      i64 deg = h.cellptr[v + 1] - h.cellptr[v];
+      int best = pv; i64 best_gain = 0;
+      for (int p = 0; p < k; ++p) {
+        if (p == pv) continue;
+        i64 gn = (i64)leave_bonus - (deg - (i64)gain[p]);
+        if (gn > best_gain && pw[p] + h.cwgt[v] <= (i64)cap) {
+          best_gain = gn; best = p;
+        }
+      }
+      if (best != pv) {
+        for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
+          i32* r = pc.row(h.cellnets[e]);
+          r[pv]--; r[best]++;
+        }
+        pw[pv] -= h.cwgt[v]; pw[best] += h.cwgt[v];
+        part[v] = best; ++moves;
+      }
+    }
+    if (moves == 0) break;
+  }
+}
+
+// Force balance: move cells out of overweight parts into the least-damaging
+// part with room (gain may be negative — feasibility first, then refine_km1
+// claws quality back).
+void rebalance_km1(const Hypergraph& h, int k, double cap,
+                   std::vector<i32>& part) {
+  PinCounts pc; pc.k = k;
+  build_pincounts(h, part, pc);
+  std::vector<i64> pw(k, 0);
+  for (i32 v = 0; v < h.ncells; ++v) pw[part[v]] += h.cwgt[v];
+  std::vector<i32> gain(k);
+  for (int pass = 0; pass < 30; ++pass) {
+    bool over = false;
+    for (int p = 0; p < k; ++p) over |= pw[p] > (i64)cap;
+    if (!over) break;
+    i64 moves = 0;
+    for (i32 v = 0; v < h.ncells; ++v) {
+      int pv = part[v];
+      if (pw[pv] <= (i64)cap) continue;
+      std::fill(gain.begin(), gain.end(), 0);
+      int leave_bonus = 0;
+      for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
+        i32* r = pc.row(h.cellnets[e]);
+        if (r[pv] == 1) leave_bonus++;
+        for (int p = 0; p < k; ++p)
+          if (p != pv && r[p] > 0) gain[p]++;
+      }
+      i64 deg = h.cellptr[v + 1] - h.cellptr[v];
+      int best = -1; i64 best_gain = 0;
+      for (int p = 0; p < k; ++p) {
+        if (p == pv || pw[p] + h.cwgt[v] > (i64)cap) continue;
+        i64 gn = (i64)leave_bonus - (deg - (i64)gain[p]);
+        if (best == -1 || gn > best_gain) { best_gain = gn; best = p; }
+      }
+      if (best != -1) {
+        for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
+          i32* r = pc.row(h.cellnets[e]);
+          r[pv]--; r[best]++;
+        }
+        pw[pv] -= h.cwgt[v]; pw[best] += h.cwgt[v];
+        part[v] = best; ++moves;
+      }
+    }
+    if (moves == 0) break;
+  }
+}
+
+void partition_hypergraph_ml(const Hypergraph& h0, int k, double imbalance,
+                             int seed, std::vector<i32>& part) {
+  std::mt19937 rng(seed);
+  std::vector<Hypergraph> levels;
+  std::vector<MatchResult> maps;
+  levels.push_back(h0);
+  const i32 coarse_target = std::max(64, 24 * k);
+  // skip nets with more pins than this during matching (cost control)
+  while (levels.back().ncells > coarse_target) {
+    const Hypergraph& cur = levels.back();
+    i64 avg_deg = cur.netpins.empty() ? 1 :
+        std::max<i64>(2, (i64)cur.netpins.size() / std::max(1, cur.nnets));
+    MatchResult m = hc_matching(cur, rng, 8 * avg_deg);
+    if (m.cn > (i32)(0.97 * cur.ncells)) break;
+    Hypergraph c = contract_h(cur, m);
+    maps.push_back(std::move(m));
+    levels.push_back(std::move(c));
+  }
+  double cap = (1.0 + imbalance) * (double)h0.total_cwgt / k;
+  // multi-start at the coarsest level: keep the best refined candidate
+  {
+    const Hypergraph& hc = levels.back();
+    double coarse_cap = cap * 1.10;     // extra slack while coarse; finest
+                                        // refinement restores the real cap
+    i64 best_km1 = -1;
+    std::vector<i32> best_part;
+    PinCounts pc; pc.k = k;
+    for (int trial = 0; trial < 8; ++trial) {
+      std::vector<i32> cand;
+      greedy_grow_h(hc, k, coarse_cap, cand, rng);
+      refine_km1(hc, k, coarse_cap, cand, 12);
+      build_pincounts(hc, cand, pc);
+      i64 score = km1_total(hc, pc);
+      if (best_km1 < 0 || score < best_km1) {
+        best_km1 = score; best_part = std::move(cand);
+      }
+    }
+    part = std::move(best_part);
+  }
+  for (int li = (int)levels.size() - 2; li >= 0; --li) {
+    const MatchResult& m = maps[li];
+    std::vector<i32> fine(levels[li].ncells);
+    for (i32 v = 0; v < levels[li].ncells; ++v) fine[v] = part[m.cmap[v]];
+    part = std::move(fine);
+    refine_km1(levels[li], k, cap, part, li == 0 ? 10 : 5);
+  }
+  rebalance_km1(h0, k, cap, part);
+  refine_km1(h0, k, cap, part, 4);
+}
+
+}  // namespace
+
+// ===================================================================== C ABI
+extern "C" {
+
+// Multilevel k-way graph partition, edge-cut objective.
+// xadj[n+1], adjncy/adjwgt[xadj[n]], vwgt[n] (nullable -> 1s).
+// Returns 0 on success; part_out[n], edgecut_out optional.
+int sgcn_partition_graph(i32 n, const i64* xadj, const i32* adjncy,
+                         const float* adjwgt, const i64* vwgt, int k,
+                         double imbalance, int seed, i32* part_out,
+                         i64* edgecut_out) {
+  if (n <= 0 || k <= 0) return 1;
+  Graph g;
+  g.n = n;
+  g.xadj.assign(xadj, xadj + n + 1);
+  g.adj.assign(adjncy, adjncy + xadj[n]);
+  if (adjwgt) g.wgt.assign(adjwgt, adjwgt + xadj[n]);
+  else g.wgt.assign(xadj[n], 1.0f);
+  if (vwgt) g.vwgt.assign(vwgt, vwgt + n);
+  else g.vwgt.assign(n, 1);
+  g.total_vwgt = std::accumulate(g.vwgt.begin(), g.vwgt.end(), (i64)0);
+  std::vector<i32> part;
+  if (k == 1) part.assign(n, 0);
+  else partition_graph_ml(g, k, imbalance, seed, part);
+  std::copy(part.begin(), part.end(), part_out);
+  if (edgecut_out) *edgecut_out = edge_cut(g, part);
+  return 0;
+}
+
+// Multilevel column-net hypergraph partition, connectivity-1 (km1) objective.
+// cells 0..ncells-1 with cellptr/cellnets adjacency into nets 0..nnets-1;
+// cwgt nullable (-> 1s). part_out[ncells], km1_out optional.
+int sgcn_partition_hypergraph(i32 ncells, i32 nnets, const i64* cellptr,
+                              const i32* cellnets, const i64* cwgt, int k,
+                              double imbalance, int seed, i32* part_out,
+                              i64* km1_out) {
+  if (ncells <= 0 || k <= 0) return 1;
+  Hypergraph h = from_cells(ncells, nnets, cellptr, cellnets, cwgt);
+  std::vector<i32> part;
+  if (k == 1) part.assign(ncells, 0);
+  else partition_hypergraph_ml(h, k, imbalance, seed, part);
+  std::copy(part.begin(), part.end(), part_out);
+  if (km1_out) {
+    PinCounts pc; pc.k = k;
+    build_pincounts(h, part, pc);
+    *km1_out = km1_total(h, pc);
+  }
+  return 0;
+}
+
+}  // extern "C"
+
+// ===================================================================== CLI
+// sgcnpart -a graph.mtx -k 4 [-m g|h|r] [-o out.part] [-e imbalance] [-s seed]
+// Reference CLI analogues: GCN-GP/main.cpp (gcngp), GCN-HP/main.cpp (gcnhgp),
+// GPU/graph + GPU/hypergraph partvec generators.
+#ifdef SGCNPART_MAIN
+#include <chrono>
+#include <fstream>
+#include <sstream>
+
+namespace {
+
+struct Coo { i32 n = 0; std::vector<i32> row, col; std::vector<float> val; };
+
+bool read_mtx(const std::string& path, Coo& out) {
+  std::ifstream f(path);
+  if (!f) return false;
+  std::string line;
+  bool symmetric = false, pattern = false, header_done = false;
+  i64 declared_nnz = 0;
+  while (std::getline(f, line)) {
+    if (line.empty()) continue;
+    if (line[0] == '%') {
+      if (line.rfind("%%MatrixMarket", 0) == 0) {
+        symmetric = line.find("symmetric") != std::string::npos;
+        pattern = line.find("pattern") != std::string::npos;
+      }
+      continue;
+    }
+    std::istringstream iss(line);
+    if (!header_done) {
+      i64 r, c, z; iss >> r >> c >> z;
+      out.n = (i32)std::max(r, c);
+      declared_nnz = z;
+      out.row.reserve(symmetric ? 2 * z : z);
+      header_done = true;
+      continue;
+    }
+    i64 i, j; double v = 1.0;
+    iss >> i >> j;
+    if (!pattern) iss >> v;
+    --i; --j;
+    out.row.push_back((i32)i); out.col.push_back((i32)j);
+    out.val.push_back((float)v);
+    if (symmetric && i != j) {
+      out.row.push_back((i32)j); out.col.push_back((i32)i);
+      out.val.push_back((float)v);
+    }
+  }
+  (void)declared_nnz;
+  return header_done;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string path, out_path;
+  int k = 2, seed = 1;
+  double imbalance = 0.03;
+  char mode = 'h';
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "-a") path = next();
+    else if (a == "-k") k = std::stoi(next());
+    else if (a == "-m") mode = next()[0];
+    else if (a == "-o") out_path = next();
+    else if (a == "-e") imbalance = std::stod(next());
+    else if (a == "-s") seed = std::stoi(next());
+    else { std::fprintf(stderr, "unknown flag %s\n", a.c_str()); return 2; }
+  }
+  if (path.empty() || k < 1 ||
+      (mode != 'g' && mode != 'h' && mode != 'r')) {
+    std::fprintf(stderr,
+        "usage: sgcnpart -a graph.mtx -k K [-m g|h|r] [-o out] [-e imb] [-s seed]\n");
+    return 2;
+  }
+  Coo coo;
+  if (!read_mtx(path, coo)) { std::fprintf(stderr, "cannot read %s\n", path.c_str()); return 1; }
+  i32 n = coo.n;
+  std::vector<i32> part(n, 0);
+  i64 metric = 0;
+  auto t0 = std::chrono::steady_clock::now();
+  if (mode == 'r') {
+    std::mt19937 rng(seed);
+    for (i32 v = 0; v < n; ++v) part[v] = (i32)(rng() % k);
+  } else if (mode == 'g') {
+    // symmetrize into CSR (graph model), dedup'd: the reader already expands
+    // symmetric storage, and general files may list both directions
+    std::vector<i64> keys;
+    keys.reserve(2 * coo.row.size());
+    for (size_t e = 0; e < coo.row.size(); ++e) {
+      i64 i = coo.row[e], j = coo.col[e];
+      if (i == j) continue;
+      keys.push_back(i * (i64)n + j);
+      keys.push_back(j * (i64)n + i);
+    }
+    std::sort(keys.begin(), keys.end());
+    keys.erase(std::unique(keys.begin(), keys.end()), keys.end());
+    std::vector<i64> xadj(n + 1, 0);
+    std::vector<i32> adj(keys.size());
+    std::vector<float> wgt(keys.size(), 1.0f);
+    for (i64 key : keys) xadj[key / n + 1]++;
+    for (i32 v = 0; v < n; ++v) xadj[v + 1] += xadj[v];
+    for (size_t e = 0; e < keys.size(); ++e) adj[e] = (i32)(keys[e] % n);
+    sgcn_partition_graph(n, xadj.data(), adj.data(), wgt.data(), nullptr, k,
+                         imbalance, seed, part.data(), &metric);
+  } else {
+    // column-net hypergraph: cells = rows, nets = cols, weight = row nnz
+    std::vector<i64> cellptr(n + 1, 0);
+    for (size_t e = 0; e < coo.row.size(); ++e) cellptr[coo.row[e] + 1]++;
+    std::vector<i64> cwgt(n);
+    for (i32 v = 0; v < n; ++v) { cwgt[v] = std::max<i64>(1, cellptr[v + 1]); }
+    for (i32 v = 0; v < n; ++v) cellptr[v + 1] += cellptr[v];
+    std::vector<i32> cellnets(coo.row.size());
+    std::vector<i64> pos(cellptr.begin(), cellptr.end() - 1);
+    for (size_t e = 0; e < coo.row.size(); ++e)
+      cellnets[pos[coo.row[e]]++] = coo.col[e];
+    sgcn_partition_hypergraph(n, n, cellptr.data(), cellnets.data(),
+                              cwgt.data(), k, imbalance, seed, part.data(),
+                              &metric);
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  double secs = std::chrono::duration<double>(t1 - t0).count();
+  // part sizes for the balance report
+  std::vector<i64> sizes(k, 0);
+  for (i32 v = 0; v < n; ++v) sizes[part[v]]++;
+  i64 maxs = *std::max_element(sizes.begin(), sizes.end());
+  std::printf("n=%d k=%d mode=%c metric=%lld max_part=%lld time_s=%.3f\n",
+              n, k, mode, (long long)metric, (long long)maxs, secs);
+  if (!out_path.empty()) {
+    std::ofstream o(out_path);
+    for (i32 v = 0; v < n; ++v) o << part[v] << "\n";
+  }
+  return 0;
+}
+#endif  // SGCNPART_MAIN

@@ -1,3 +1,13 @@
 from .random_part import random_partition, balanced_random_partition
+from .native import partition_graph, partition_hypergraph_colnet
+from .emit import (
+    read_buff, read_conn, read_partvec, read_partvec_pickle,
+    write_partvec, write_partvec_pickle, write_rank_files,
+)
 
-__all__ = ["random_partition", "balanced_random_partition"]
+__all__ = [
+    "random_partition", "balanced_random_partition",
+    "partition_graph", "partition_hypergraph_colnet",
+    "read_buff", "read_conn", "read_partvec", "read_partvec_pickle",
+    "write_partvec", "write_partvec_pickle", "write_rank_files",
+]

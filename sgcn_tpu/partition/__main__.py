@@ -1,0 +1,87 @@
+"""Partitioner CLI — role of the reference's four partitioner executables.
+
+``python -m sgcn_tpu.partition -a A.mtx -k 8 -m hp``            → ``A.mtx.8.hp``
+``python -m sgcn_tpu.partition -a A.mtx -k 8 -m gp,rp``         → both flavors
+``python -m sgcn_tpu.partition -a A.mtx -k 4 -m hp --rank-files out/ -y Y.mtx -l 2 --hidden 16``
+                                                → A.r/H.r/Y.r/conn.r/buff.r/config
+
+Reference analogues: ``GCN-HP`` (PaToH colnet + rank files), ``GCN-GP``
+(METIS + rank files), ``GPU/graph`` (METIS partvec ``.gp`` + random ``.rp``),
+``GPU/hypergraph`` (PaToH partvec ``.hp`` + ``.rp``).  A native C++ CLI with
+the same core (``native/sgcnpart``) is also built by ``make -C native``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+from ..io.config import ModelConfig
+from ..io.mtx import read_mtx
+from .emit import write_partvec, write_rank_files
+from .random_part import balanced_random_partition
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="sgcn_tpu partitioner")
+    p.add_argument("-a", "--adjacency", required=True)
+    p.add_argument("-k", "--nparts", type=int, required=True)
+    p.add_argument("-m", "--modes", default="hp",
+                   help="comma list of gp|hp|rp (graph/hypergraph/random)")
+    p.add_argument("-e", "--imbalance", type=float, default=0.03)
+    p.add_argument("-s", "--seed", type=int, default=1)
+    p.add_argument("-o", "--out-prefix", default=None,
+                   help="default: <adjacency path>")
+    p.add_argument("--rank-files", default=None,
+                   help="also emit per-rank A.r/H.r/Y.r/conn.r/buff.r/config to this dir (first mode)")
+    p.add_argument("-y", "--labels", default=None, help=".mtx labels for rank files")
+    p.add_argument("-f", "--features", default=None, help=".mtx features for rank files")
+    p.add_argument("-l", "--nlayers", type=int, default=2)
+    p.add_argument("--hidden", type=int, default=16)
+    args = p.parse_args()
+
+    a = read_mtx(args.adjacency)
+    n = a.shape[0]
+    prefix = args.out_prefix or args.adjacency
+    first_pv = None
+    for mode in args.modes.split(","):
+        t0 = time.perf_counter()
+        if mode == "gp":
+            from .native import partition_graph
+            pv, metric = partition_graph(a, args.nparts, args.imbalance, args.seed)
+            mname = "edgecut"
+        elif mode == "hp":
+            from .native import partition_hypergraph_colnet
+            pv, metric = partition_hypergraph_colnet(a, args.nparts,
+                                                     args.imbalance, args.seed)
+            mname = "km1"
+        elif mode == "rp":
+            pv = balanced_random_partition(n, args.nparts, args.seed)
+            metric, mname = -1, "none"
+        else:
+            raise SystemExit(f"unknown mode {mode}")
+        dt = time.perf_counter() - t0
+        out = f"{prefix}.{args.nparts}.{mode}"
+        write_partvec(out, pv)
+        sizes = np.bincount(pv, minlength=args.nparts)
+        print(f"{mode}: {out}  {mname}={metric}  max_part={sizes.max()}  "
+              f"time_s={dt:.3f}", flush=True)
+        if first_pv is None:
+            first_pv = pv
+
+    if args.rank_files:
+        import scipy.sparse as sp
+        y = read_mtx(args.labels) if args.labels else sp.eye(n, 2, format="csr")
+        h = read_mtx(args.features) if args.features else sp.csr_matrix(
+            np.ones((n, 1), dtype=np.float32))
+        nclasses = y.shape[1]
+        cfg = ModelConfig(nlayers=args.nlayers, nvtx=n,
+                          widths=[args.hidden] * (args.nlayers - 1) + [nclasses])
+        write_rank_files(args.rank_files, a, h, y, first_pv, args.nparts, cfg)
+        print(f"rank files → {args.rank_files}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""ctypes bindings for the native multilevel partitioners (native/sgcnpart.cpp).
+
+Role parity: ``METIS_PartGraphKway`` as called by ``GCN-GP/main.cpp:334`` and
+``GPU/graph/main.cpp:300-361`` (graph model, edge-cut objective), and
+``PaToH_Part`` as called by ``GCN-HP/main.cpp:317-354`` / KaHyPar in
+``GPU/SHP/main.py:17-32`` (column-net hypergraph model, connectivity-1 / km1
+objective, cells weighted by row nnz).
+
+The shared library is built on demand with ``make -C native`` (g++ only, no
+third-party deps — we implement the multilevel algorithms ourselves).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import scipy.sparse as sp
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libsgcnpart.so")
+
+_lib = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    # always invoke make: the target is incremental, so this is a no-op when
+    # fresh and rebuilds transparently after sgcnpart.cpp edits
+    subprocess.run(["make", "-C", _NATIVE_DIR, "libsgcnpart.so"],
+                   check=True, capture_output=True)
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.sgcn_partition_graph.restype = ctypes.c_int
+    lib.sgcn_partition_graph.argtypes = [
+        ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_void_p,   # adjwgt (nullable)
+        ctypes.c_void_p,   # vwgt (nullable)
+        ctypes.c_int, ctypes.c_double, ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.sgcn_partition_hypergraph.restype = ctypes.c_int
+    lib.sgcn_partition_hypergraph.argtypes = [
+        ctypes.c_int32, ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_void_p,   # cwgt (nullable)
+        ctypes.c_int, ctypes.c_double, ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    _lib = lib
+    return lib
+
+
+def partition_graph(a: sp.spmatrix, k: int, imbalance: float = 0.03,
+                    seed: int = 1) -> tuple[np.ndarray, int]:
+    """Multilevel k-way graph partition of the symmetrized pattern of ``a``.
+
+    Matches the reference pipeline: symmetrize, drop self-loops, unit edge
+    weights (``GCN-GP/main.cpp:114-121``, ``GPU/graph/main.cpp:123-131``).
+    Returns (partvec int64 (n,), edge cut).
+    """
+    a = sp.csr_matrix(a)
+    n = a.shape[0]
+    pat = a.copy()
+    pat.data[:] = 1.0
+    sym = ((pat + pat.T) > 0).astype(np.float32)
+    sym.setdiag(0)
+    sym.eliminate_zeros()
+    sym = sp.csr_matrix(sym)
+    lib = _load()
+    part = np.empty(n, dtype=np.int32)
+    cut = ctypes.c_int64(0)
+    rc = lib.sgcn_partition_graph(
+        n, sym.indptr.astype(np.int64), sym.indices.astype(np.int32),
+        None, None, k, imbalance, seed, part, ctypes.byref(cut))
+    if rc != 0:
+        raise RuntimeError(f"sgcn_partition_graph failed rc={rc}")
+    return part.astype(np.int64), int(cut.value)
+
+
+def partition_hypergraph_colnet(a: sp.spmatrix, k: int,
+                                imbalance: float = 0.03,
+                                seed: int = 1) -> tuple[np.ndarray, int]:
+    """Column-net hypergraph partition: cells = rows (weight = row nnz),
+    nets = columns, km1/connectivity-1 objective (``GCN-HP/main.cpp:289-345``).
+
+    Returns (partvec int64 (n,), km1 = Σ(λ−1)).
+    """
+    a = sp.csr_matrix(a)
+    n, m = a.shape
+    lib = _load()
+    part = np.empty(n, dtype=np.int32)
+    km1 = ctypes.c_int64(0)
+    cwgt = np.maximum(np.diff(a.indptr), 1).astype(np.int64)
+    rc = lib.sgcn_partition_hypergraph(
+        n, m, a.indptr.astype(np.int64), a.indices.astype(np.int32),
+        cwgt.ctypes.data_as(ctypes.c_void_p), k, imbalance, seed, part,
+        ctypes.byref(km1))
+    if rc != 0:
+        raise RuntimeError(f"sgcn_partition_hypergraph failed rc={rc}")
+    return part.astype(np.int64), int(km1.value)

@@ -1,0 +1,134 @@
+"""Native partitioner invariants: validity, balance, beats-random quality
+(SURVEY.md §7.3: accept any partition beating random by the expected margin),
+and the L2 file-family round trip."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from sgcn_tpu.io.config import ModelConfig
+from sgcn_tpu.parallel import build_comm_plan
+from sgcn_tpu.partition import (
+    balanced_random_partition, partition_graph, partition_hypergraph_colnet,
+    read_buff, read_conn, read_partvec, read_partvec_pickle, write_partvec,
+    write_partvec_pickle, write_rank_files,
+)
+
+
+def community_graph(n=600, c=6, seed=0):
+    """Planted-community graph: partitioners should find the communities."""
+    rng = np.random.default_rng(seed)
+    comm = rng.integers(0, c, n)
+    rows, cols = [], []
+    members = [np.where(comm == ci)[0] for ci in range(c)]
+    for _ in range(n * 6):
+        i = int(rng.integers(0, n))
+        if rng.random() < 0.9:
+            m = members[comm[i]]
+            j = int(m[rng.integers(0, len(m))])
+        else:
+            j = int(rng.integers(0, n))
+        if i != j:
+            rows.append(i)
+            cols.append(j)
+    a = sp.coo_matrix((np.ones(len(rows), np.float32), (rows, cols)), shape=(n, n))
+    return sp.csr_matrix(((a + a.T) > 0).astype(np.float32))
+
+
+def _cut(a, pv):
+    coo = a.tocoo()
+    return int((pv[coo.row] != pv[coo.col]).sum()) // 2
+
+
+def _km1(a, pv):
+    """Standard connectivity-1: Σ over columns (nets) of (#parts among the
+    column's pin rows − 1). Equals halo send volume when every vertex's own
+    column has a diagonal nonzero (i.e. after self-loop normalization)."""
+    coo = a.tocoo()
+    total = 0
+    for v in range(a.shape[0]):
+        rows = coo.row[coo.col == v]
+        if len(rows):
+            total += len(np.unique(pv[rows])) - 1
+    return total
+
+
+@pytest.fixture(scope="module")
+def cgraph():
+    return community_graph()
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_graph_partitioner(cgraph, k):
+    n = cgraph.shape[0]
+    pv, cut = partition_graph(cgraph, k, imbalance=0.05, seed=1)
+    assert pv.shape == (n,) and pv.min() >= 0 and pv.max() < k
+    sizes = np.bincount(pv, minlength=k)
+    assert sizes.max() <= (1.05 * n / k) + 1
+    assert cut == _cut(cgraph, pv)              # self-reported metric is honest
+    rand_cut = _cut(cgraph, balanced_random_partition(n, k, seed=9))
+    assert cut < 0.6 * rand_cut                 # beats random by a wide margin
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_hypergraph_partitioner(cgraph, k):
+    n = cgraph.shape[0]
+    pv, km1 = partition_hypergraph_colnet(cgraph, k, imbalance=0.05, seed=1)
+    assert pv.shape == (n,) and pv.min() >= 0 and pv.max() < k
+    assert km1 == _km1(cgraph, pv)   # self-reported metric is honest
+    rand = _km1(cgraph, balanced_random_partition(n, k, seed=9))
+    assert km1 < 0.6 * rand
+    # balance is on cell weight = row nnz
+    w = np.asarray(cgraph.sum(axis=1)).ravel()
+    pw = np.bincount(pv, weights=w, minlength=k)
+    assert pw.max() <= 1.06 * w.sum() / k + w.max()
+
+
+def test_hp_beats_gp_on_volume(cgraph):
+    """The paper's claim: connectivity-objective partitioning gives lower comm
+    volume than edge-cut partitioning (or at worst comparable)."""
+    k = 6
+    pv_g, _ = partition_graph(cgraph, k, seed=1)
+    pv_h, _ = partition_hypergraph_colnet(cgraph, k, seed=1)
+    vol_g = build_comm_plan(cgraph, pv_g, k).predicted_send_volume.sum()
+    vol_h = build_comm_plan(cgraph, pv_h, k).predicted_send_volume.sum()
+    assert vol_h <= 1.25 * vol_g
+
+
+def test_partvec_roundtrip(tmp_path):
+    pv = np.array([0, 1, 2, 1, 0], dtype=np.int64)
+    p1 = str(tmp_path / "pv.txt")
+    p2 = str(tmp_path / "pv.pkl")
+    write_partvec(p1, pv)
+    write_partvec_pickle(p2, pv)
+    np.testing.assert_array_equal(read_partvec(p1), pv)
+    np.testing.assert_array_equal(read_partvec_pickle(p2), pv)
+
+
+def test_rank_files_consistent_with_plan(tmp_path, ahat):
+    """conn/buff files must agree with the runtime comm plan (the reference's
+    offline conn.r/buff.r are consumed by the trainer at startup —
+    Parallel-GCN/main.c:456-551)."""
+    n = ahat.shape[0]
+    k = 4
+    pv = balanced_random_partition(n, k, seed=3)
+    y = sp.csr_matrix((np.ones(n, np.float32),
+                       (np.arange(n), np.arange(n) % 3)), shape=(n, 3))
+    h = sp.csr_matrix(np.ones((n, 2), dtype=np.float32))
+    cfg = ModelConfig(nlayers=2, nvtx=n, widths=[8, 3])
+    write_rank_files(str(tmp_path), ahat, h, y, pv, k, cfg)
+    plan = build_comm_plan(ahat, pv, k)
+    for r in range(k):
+        conn = read_conn(str(tmp_path / f"conn.{r}"))
+        buff = read_buff(str(tmp_path / f"buff.{r}"))
+        for q, gids in conn.items():
+            assert len(gids) == plan.send_counts[r, q]
+            assert (pv[gids] == r).all()        # we only send rows we own
+        for q, cnt in buff.items():
+            assert cnt == plan.send_counts[q, r]
+        # A.r holds exactly the rows owned by r
+        with open(tmp_path / f"A.{r}") as f:
+            hdr = f.readline().split()
+            assert int(hdr[0]) == n
+            rows = {int(line.split()[0]) for line in f}
+        assert rows.issubset(set(np.where(pv == r)[0]))
