@@ -634,7 +634,8 @@ bool read_mtx(const std::string& path, Coo& out) {
     }
     std::istringstream iss(line);
     if (!header_done) {
-      i64 r, c, z; iss >> r >> c >> z;
+      i64 r, c, z;
+      if (!(iss >> r >> c >> z)) return false;
       out.n = (i32)std::max(r, c);
       declared_nnz = z;
       out.row.reserve(symmetric ? 2 * z : z);
@@ -642,9 +643,20 @@ bool read_mtx(const std::string& path, Coo& out) {
       continue;
     }
     i64 i, j; double v = 1.0;
-    iss >> i >> j;
-    if (!pattern) iss >> v;
+    if (!(iss >> i >> j)) {
+      // tolerate whitespace-only tails; reject anything else
+      std::string tok;
+      std::istringstream chk(line);
+      if (chk >> tok) { std::fprintf(stderr, "bad mtx line: %s\n", line.c_str()); return false; }
+      continue;
+    }
+    if (!pattern && !(iss >> v)) { std::fprintf(stderr, "bad mtx line: %s\n", line.c_str()); return false; }
     --i; --j;
+    if (i < 0 || j < 0 || i >= out.n || j >= out.n) {
+      std::fprintf(stderr, "mtx index out of range: %lld %lld\n",
+                   (long long)(i + 1), (long long)(j + 1));
+      return false;
+    }
     out.row.push_back((i32)i); out.col.push_back((i32)j);
     out.val.push_back((float)v);
     if (symmetric && i != j) {

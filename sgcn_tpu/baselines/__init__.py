@@ -1,3 +1,4 @@
 from .oracle import DenseOracle
+from .gat_oracle import DenseGATOracle
 
-__all__ = ["DenseOracle"]
+__all__ = ["DenseOracle", "DenseGATOracle"]

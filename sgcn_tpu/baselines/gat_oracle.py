@@ -1,12 +1,9 @@
-"""Single-device dense oracle — ground truth for distributed parity tests.
+"""Single-device dense GAT oracle — ground truth for distributed GAT parity.
 
-The reference's correctness story is empirical: the single-process DGL GCN
-(``DGL/gcn.py``) trained on the same preprocessed inputs is the ground truth
-the distributed runs are eyeballed against, and ``GPU/PGCN-Accuracy.py`` checks
-partitioned training does not change predictive performance (``README.md:110``).
-We make that an automated golden test: this oracle runs the *same* math as the
-distributed trainer (same init seed, same optimizer, same loss) on one device
-with a dense Â, and tests assert loss/logit/gradient parity to tolerance.
+Same role as ``DenseOracle`` (DGL-baseline analogue, SURVEY.md §4): identical
+math to the distributed GAT — masked neighbor softmax ``e_ij = z1_i + z2_j``
+over the Â nonzero pattern, ``H' = α·Z`` (``GPU/PGAT.py:137-150`` semantics
+with proper -inf masking) — on one device with a dense mask.
 """
 
 from __future__ import annotations
@@ -18,20 +15,21 @@ import optax
 import scipy.sparse as sp
 
 from ..models.activations import get_activation
-from ..models.gcn import init_gcn_params
+from ..models.gat import init_gat_params
+
+_NEG = -1e30
 
 
-class DenseOracle:
-    """Single-device full-batch GCN with dense adjacency (DGL/gcn.py role)."""
-
+class DenseGATOracle:
     def __init__(self, a: sp.spmatrix, fin: int, widths: list[int],
-                 lr: float = 0.01, activation: str = "relu",
+                 lr: float = 0.01, activation: str = "none",
                  final_activation: str = "none",
                  optimizer: optax.GradientTransformation | None = None,
                  seed: int = 0):
-        self.a = jnp.asarray(sp.coo_matrix(a).todense(), dtype=jnp.float32)
+        self.mask = jnp.asarray(
+            (sp.coo_matrix(a).todense() > 0), dtype=bool)
         dims = list(zip([fin] + widths[:-1], widths))
-        self.params = init_gcn_params(jax.random.PRNGKey(seed), dims)
+        self.params = init_gat_params(jax.random.PRNGKey(seed), dims)
         self.opt = optimizer if optimizer is not None else optax.adam(lr)
         self.opt_state = self.opt.init(self.params)
         self.activation = activation
@@ -42,9 +40,14 @@ class DenseOracle:
         act = get_activation(self.activation)
         fact = get_activation(self.final_activation)
         nl = len(params)
-        for i, w in enumerate(params):
-            z = (self.a @ h) @ w
-            h = fact(z) if i == nl - 1 else act(z)
+        for i, p in enumerate(params):
+            z = h @ p["w"]
+            scores = (z @ p["a1"])[:, None] + (z @ p["a2"])[None, :]
+            scores = jnp.where(self.mask, scores, _NEG)
+            alpha = jax.nn.softmax(scores, axis=-1)
+            alpha = jnp.where(self.mask, alpha, 0.0)
+            h = alpha @ z
+            h = fact(h) if i == nl - 1 else act(h)
         return h
 
     def loss(self, params, h, labels, mask):

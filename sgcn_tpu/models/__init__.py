@@ -1,3 +1,7 @@
 from .gcn import init_gcn_params, gcn_forward_local, masked_softmax_xent_local
+from .gat import init_gat_params, gat_forward_local, gat_layer_local, edge_softmax
 
-__all__ = ["init_gcn_params", "gcn_forward_local", "masked_softmax_xent_local"]
+__all__ = [
+    "init_gcn_params", "gcn_forward_local", "masked_softmax_xent_local",
+    "init_gat_params", "gat_forward_local", "gat_layer_local", "edge_softmax",
+]

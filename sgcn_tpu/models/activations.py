@@ -1,0 +1,24 @@
+"""Shared activation registry for all models, trainers, and oracles.
+
+One table so every model accepts the same names: ``relu`` (torch-flavor GCN,
+``GPU/PGCN.py:147``), ``sigmoid`` (MPI flavor, ``Parallel-GCN/main.c:79-81``),
+``elu`` (standard GAT variant), ``none``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+ACTS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "elu": jax.nn.elu,
+    "none": lambda x: x,
+}
+
+
+def get_activation(name: str):
+    try:
+        return ACTS[name]
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}; one of {sorted(ACTS)}")

@@ -23,12 +23,7 @@ from jax import lax
 
 from ..ops.pspmm import pspmm_exchange
 from ..parallel.mesh import AXIS
-
-_ACTS = {
-    "relu": jax.nn.relu,
-    "sigmoid": jax.nn.sigmoid,
-    "none": lambda x: x,
-}
+from .activations import get_activation
 
 
 def init_gcn_params(rng: jax.Array, dims: list[tuple[int, int]]):
@@ -55,8 +50,8 @@ def gcn_forward_local(
     axis_name: str = AXIS,
 ):
     """Per-chip forward: L × (pspmm → dense matmul → activation) → (B, nout)."""
-    act = _ACTS[activation]
-    fact = _ACTS[final_activation]
+    act = get_activation(activation)
+    fact = get_activation(final_activation)
     nl = len(params)
     for i, w in enumerate(params):
         ah = pspmm_exchange(h, send_idx, halo_src, edge_dst, edge_src, edge_w,

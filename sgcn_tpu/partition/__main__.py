@@ -37,7 +37,6 @@ def main() -> None:
     p.add_argument("--rank-files", default=None,
                    help="also emit per-rank A.r/H.r/Y.r/conn.r/buff.r/config to this dir (first mode)")
     p.add_argument("-y", "--labels", default=None, help=".mtx labels for rank files")
-    p.add_argument("-f", "--features", default=None, help=".mtx features for rank files")
     p.add_argument("-l", "--nlayers", type=int, default=2)
     p.add_argument("--hidden", type=int, default=16)
     args = p.parse_args()
@@ -74,12 +73,10 @@ def main() -> None:
     if args.rank_files:
         import scipy.sparse as sp
         y = read_mtx(args.labels) if args.labels else sp.eye(n, 2, format="csr")
-        h = read_mtx(args.features) if args.features else sp.csr_matrix(
-            np.ones((n, 1), dtype=np.float32))
         nclasses = y.shape[1]
         cfg = ModelConfig(nlayers=args.nlayers, nvtx=n,
                           widths=[args.hidden] * (args.nlayers - 1) + [nclasses])
-        write_rank_files(args.rank_files, a, h, y, first_pv, args.nparts, cfg)
+        write_rank_files(args.rank_files, a, y, first_pv, args.nparts, cfg)
         print(f"rank files → {args.rank_files}", flush=True)
 
 

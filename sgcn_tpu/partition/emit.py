@@ -53,7 +53,7 @@ def read_partvec_pickle(path: str) -> np.ndarray:
 
 
 # ------------------------------------------------------------- per-rank family
-def write_rank_files(outdir: str, a: sp.spmatrix, h: sp.spmatrix,
+def write_rank_files(outdir: str, a: sp.spmatrix,
                      y: sp.spmatrix, pv: np.ndarray, k: int,
                      cfg: ModelConfig) -> None:
     """Emit ``A.r / H.r / Y.r / conn.r / buff.r / config`` for r in 0..k-1.
@@ -62,7 +62,10 @@ def write_rank_files(outdir: str, a: sp.spmatrix, h: sp.spmatrix,
     exactly as in the reference, ``Parallel-GCN/main.c:609-685``):
 
       * ``A.r``:   ``n nnz_r`` then ``i j v`` triplet lines (rows owned by r);
-      * ``H.r``:   ``nrows`` then one global row id per line (owned rows);
+      * ``H.r``:   ``nrows`` then one global row id per line (owned rows) —
+        like the reference's ``print_parts2`` (``GCN-HP/main.cpp:251-282``),
+        ids only; the trainer synthesizes the feature rows
+        (``Parallel-GCN/main.c:650-685``), so no feature values are stored;
       * ``Y.r``:   ``n nnz_r`` then ``i j v`` triplets of owned label rows;
       * ``conn.r``: ``nt`` then per target ``q cnt g1 ... gcnt`` — global ids
         of boundary rows r must send to q each layer;

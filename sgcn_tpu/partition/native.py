@@ -32,8 +32,11 @@ def _load() -> ctypes.CDLL:
         return _lib
     # always invoke make: the target is incremental, so this is a no-op when
     # fresh and rebuilds transparently after sgcnpart.cpp edits
-    subprocess.run(["make", "-C", _NATIVE_DIR, "libsgcnpart.so"],
-                   check=True, capture_output=True)
+    proc = subprocess.run(["make", "-C", _NATIVE_DIR, "libsgcnpart.so"],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native partitioner build failed:\n{proc.stdout}\n{proc.stderr}")
     lib = ctypes.CDLL(_LIB_PATH)
     lib.sgcn_partition_graph.restype = ctypes.c_int
     lib.sgcn_partition_graph.argtypes = [

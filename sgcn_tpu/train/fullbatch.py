@@ -32,6 +32,7 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..models.gat import gat_forward_local, init_gat_params
 from ..models.gcn import (
     gcn_forward_local,
     init_gcn_params,
@@ -41,6 +42,14 @@ from ..models.gcn import (
 from ..parallel.mesh import AXIS, make_mesh_1d, replicate, shard_stacked
 from ..parallel.plan import CommPlan
 from ..utils.stats import CommStats
+
+# model registry: name → (param init, per-chip forward). GAT is the reference's
+# PGAT capability (GPU/PGAT.py) on the same trainer scaffold — like the
+# reference, only the nn.Module differs between PGCN.py and PGAT.py.
+MODELS = {
+    "gcn": (init_gcn_params, gcn_forward_local),
+    "gat": (init_gat_params, gat_forward_local),
+}
 
 
 @dataclass
@@ -104,13 +113,16 @@ class FullBatchTrainer:
         final_activation: str = "none",
         optimizer: optax.GradientTransformation | None = None,
         seed: int = 0,
+        model: str = "gcn",
     ):
         self.plan = plan
         self.mesh = mesh if mesh is not None else make_mesh_1d(plan.k)
         self.activation = activation
         self.final_activation = final_activation
+        init_fn, self._forward_fn = MODELS[model]
+        self.model = model
         dims = list(zip([fin] + widths[:-1], widths))
-        self.params = init_gcn_params(jax.random.PRNGKey(seed), dims)
+        self.params = init_fn(jax.random.PRNGKey(seed), dims)
         self.opt = optimizer if optimizer is not None else optax.adam(lr)
         self.opt_state = self.opt.init(self.params)
         self.params = replicate(self.mesh, self.params)
@@ -122,7 +134,7 @@ class FullBatchTrainer:
 
     # ------------------------------------------------------------------ build
     def _forward(self, params, pa, h0):
-        return gcn_forward_local(
+        return self._forward_fn(
             params, h0,
             pa["send_idx"], pa["halo_src"],
             pa["edge_dst"], pa["edge_src"], pa["edge_w"],

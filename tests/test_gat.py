@@ -1,0 +1,67 @@
+"""Distributed GAT vs dense single-device GAT oracle (SURVEY.md §4 strategy)."""
+
+import numpy as np
+import pytest
+
+from sgcn_tpu.baselines.gat_oracle import DenseGATOracle
+from sgcn_tpu.models.gat import init_gat_params
+from sgcn_tpu.parallel import build_comm_plan
+from sgcn_tpu.partition import balanced_random_partition
+from sgcn_tpu.train import FullBatchTrainer, make_train_data
+
+K = 4
+
+
+@pytest.fixture(scope="module")
+def setup(ahat):
+    n = ahat.shape[0]
+    rng = np.random.default_rng(7)
+    partvec = balanced_random_partition(n, K, seed=3)
+    plan = build_comm_plan(ahat, partvec, K)
+    feats = rng.standard_normal((n, 12)).astype(np.float32)
+    labels = (rng.integers(0, 4, n)).astype(np.int32)
+    return plan, feats, labels
+
+
+def test_gat_forward_parity(ahat, setup):
+    plan, feats, labels = setup
+    widths = [8, 4]
+    tr = FullBatchTrainer(plan, fin=12, widths=widths, model="gat",
+                          activation="none", final_activation="none", seed=5)
+    oracle = DenseGATOracle(ahat, fin=12, widths=widths,
+                            activation="none", final_activation="none", seed=5)
+    data = make_train_data(plan, feats, labels)
+    got = tr.predict(data)
+    want = oracle.predict(feats)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_gat_training_parity(ahat, setup):
+    plan, feats, labels = setup
+    widths = [8, 4]
+    tr = FullBatchTrainer(plan, fin=12, widths=widths, model="gat",
+                          activation="none", lr=0.01, seed=5)
+    oracle = DenseGATOracle(ahat, fin=12, widths=widths,
+                            activation="none", lr=0.01, seed=5)
+    data = make_train_data(plan, feats, labels)
+    dist_losses = [tr.step(data) for _ in range(6)]
+    oracle_losses = oracle.fit(feats, labels, epochs=6)
+    np.testing.assert_allclose(dist_losses, oracle_losses, rtol=2e-3, atol=2e-4)
+    assert dist_losses[-1] < dist_losses[0]
+
+
+def test_gat_elu_variant_runs(ahat, setup):
+    plan, feats, labels = setup
+    tr = FullBatchTrainer(plan, fin=12, widths=[8, 4], model="gat",
+                          activation="elu", seed=0)
+    data = make_train_data(plan, feats, labels)
+    losses = [tr.step(data) for _ in range(4)]
+    assert np.isfinite(losses).all()
+
+
+def test_gat_params_shapes():
+    import jax
+    params = init_gat_params(jax.random.PRNGKey(0), [(12, 8), (8, 4)])
+    assert params[0]["w"].shape == (12, 8)
+    assert params[0]["a1"].shape == (8,)
+    assert params[1]["a2"].shape == (4,)

@@ -114,9 +114,8 @@ def test_rank_files_consistent_with_plan(tmp_path, ahat):
     pv = balanced_random_partition(n, k, seed=3)
     y = sp.csr_matrix((np.ones(n, np.float32),
                        (np.arange(n), np.arange(n) % 3)), shape=(n, 3))
-    h = sp.csr_matrix(np.ones((n, 2), dtype=np.float32))
     cfg = ModelConfig(nlayers=2, nvtx=n, widths=[8, 3])
-    write_rank_files(str(tmp_path), ahat, h, y, pv, k, cfg)
+    write_rank_files(str(tmp_path), ahat, y, pv, k, cfg)
     plan = build_comm_plan(ahat, pv, k)
     for r in range(k):
         conn = read_conn(str(tmp_path / f"conn.{r}"))
