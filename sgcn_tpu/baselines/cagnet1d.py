@@ -1,0 +1,166 @@
+"""CAGNET-style uniform 1D broadcast baseline (comparison, inference-only).
+
+Reference: ``Cagnet/main.c`` — the baseline the paper's partitioned algorithm
+is measured against.  Per layer, every rank round-robin ``MPI_Bcast``s its
+whole H block and all ranks accumulate ``A_local · H_bcast``
+(``Cagnet/main.c:158-208``); forward-only, 5 epochs, sigmoid activations
+(``:204-207``), with a phase-time breakdown (data-comm / local-SpMM /
+update, ``:35-38,148-151,171-175,395-413``).
+
+TPU-native form: the k-round broadcast ring collapses into ONE
+``lax.all_gather`` of the local block per layer — every chip then holds the
+full (k·B, f) feature table and runs its local SpMM against it.  Unlike the
+partitioned path there is no boundary selection: the whole feature matrix
+crosses the interconnect every layer regardless of the partition quality,
+which is exactly the inefficiency the paper's halo exchange removes (and what
+makes this a meaningful comparison baseline).
+
+For the phase breakdown the comm (all_gather) and compute (SpMM + dense) are
+compiled as separate programs with a host sync between — slightly slower than
+the fused single program, but it reports the comm/compute split the reference
+baseline instruments; ``fused=True`` gives the single-program variant for
+best-case timing.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..models.activations import get_activation
+from ..models.gcn import init_gcn_params
+from ..parallel.mesh import AXIS, make_mesh_1d, replicate, shard_stacked
+from ..parallel.plan import CommPlan, relabel_plan
+from ..utils.timers import PhaseTimer
+
+
+def broadcast_edge_lists(a, plan: CommPlan):
+    """Per-chip edge lists whose src indexes the all-gathered (k·B, f) table.
+
+    Same local rows as the plan, but src = owner·B + local_idx (global-table
+    slot) instead of the [local; halo] compaction.
+    """
+    import scipy.sparse as sp
+
+    a = sp.coo_matrix(a)
+    k, b = plan.k, plan.b
+    eo = plan.owner[a.row]
+    e = plan.e
+    edge_dst = np.full((k, e), b - 1, dtype=np.int32)
+    edge_src = np.zeros((k, e), dtype=np.int32)
+    edge_w = np.zeros((k, e), dtype=np.float32)
+    for p in range(k):
+        em = eo == p
+        rows = plan.local_idx[a.row[em]].astype(np.int32)
+        cols = a.col[em]
+        gsrc = (plan.owner[cols] * b + plan.local_idx[cols]).astype(np.int32)
+        vals = a.data[em].astype(np.float32)
+        srt = np.argsort(rows, kind="stable")
+        cnt = int(em.sum())
+        edge_dst[p, :cnt] = rows[srt]
+        edge_src[p, :cnt] = gsrc[srt]
+        edge_w[p, :cnt] = vals[srt]
+    return edge_dst, edge_src, edge_w
+
+
+class BroadcastGCN1D:
+    """Inference-only 1D-broadcast GCN over the mesh (Cagnet/main.c role)."""
+
+    def __init__(self, a, partvec: np.ndarray, k: int, fin: int,
+                 widths: list[int], mesh=None, activation: str = "sigmoid",
+                 seed: int = 0, fused: bool = False):
+        # relabel-only plan: the broadcast baseline has no halo exchange, so
+        # the partitioned path's send/halo construction would be dead work
+        self.plan = relabel_plan(a, partvec, k)
+        self.mesh = mesh if mesh is not None else make_mesh_1d(k)
+        self.activation = activation
+        self.fused = fused
+        dims = list(zip([fin] + widths[:-1], widths))
+        self.params = replicate(
+            self.mesh, init_gcn_params(jax.random.PRNGKey(seed), dims))
+        ed, es, ew = broadcast_edge_lists(a, self.plan)
+        self.pa = shard_stacked(
+            self.mesh, {"edge_dst": ed, "edge_src": es, "edge_w": ew})
+        self.timer = PhaseTimer()
+        self._gather = self._build_gather()
+        self._compute = self._build_compute()
+        self._fused = self._build_fused()
+
+    # ---------------------------------------------------------------- builders
+    def _smap(self, fn, in_specs, out_specs):
+        return jax.jit(jax.shard_map(
+            fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs))
+
+    def _build_gather(self):
+        def per_chip(h):
+            h = h[0]
+            full = lax.all_gather(h, AXIS)            # (k, B, f)
+            return full.reshape(-1, h.shape[-1])[None]
+        return self._smap(per_chip, (P(AXIS),), P(AXIS))
+
+    def _build_compute(self):
+        act = get_activation(self.activation)
+
+        def per_chip(w, pa, table):
+            pa, table = jax.tree.map(lambda x: x[0], (pa, table))
+            gathered = jnp.take(table, pa["edge_src"], axis=0) * pa["edge_w"][:, None]
+            ah = jax.ops.segment_sum(
+                gathered, pa["edge_dst"], num_segments=self.plan.b,
+                indices_are_sorted=True)
+            return act(ah @ w)[None]
+        return self._smap(per_chip, (P(), P(AXIS), P(AXIS)), P(AXIS))
+
+    def _build_fused(self):
+        act = get_activation(self.activation)
+
+        def per_chip(params, pa, h):
+            pa, h = jax.tree.map(lambda x: x[0], (pa, h))
+            for w in params:
+                full = lax.all_gather(h, AXIS).reshape(-1, h.shape[-1])
+                gathered = jnp.take(full, pa["edge_src"], axis=0) * pa["edge_w"][:, None]
+                ah = jax.ops.segment_sum(
+                    gathered, pa["edge_dst"], num_segments=self.plan.b,
+                    indices_are_sorted=True)
+                h = act(ah @ w)
+            return h[None]
+        return self._smap(per_chip, (P(), P(AXIS), P(AXIS)), P(AXIS))
+
+    # --------------------------------------------------------------------- api
+    def forward(self, features: np.ndarray) -> np.ndarray:
+        """One inference pass; returns global (n, nout) activations."""
+        h = shard_stacked(self.mesh, self.plan.scatter_rows(
+            features.astype(np.float32)))
+        if self.fused:
+            with self.timer.phase("total", sync=lambda: h):
+                h = self._fused(self.params, self.pa, h)
+        else:
+            for w in self.params:
+                with self.timer.phase("data_comm", sync=lambda: table):
+                    table = self._gather(h)
+                with self.timer.phase("local_spmm", sync=lambda: h):
+                    h = self._compute(w, self.pa, table)
+        return self.plan.gather_rows(np.asarray(h))
+
+    def run_epochs(self, features: np.ndarray,
+                   epochs: int = 5) -> tuple[dict, np.ndarray]:
+        """Reference protocol: repeated forward passes, phase times reported
+        (``Cagnet/main.c:125-220,395-413``)."""
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            out = self.forward(features)
+        elapsed = time.perf_counter() - t0
+        report = {
+            "epochs": epochs,
+            "elapsed_s": elapsed,
+            "epoch_s": elapsed / max(epochs, 1),
+            "phases": self.timer.report(),
+            # the broadcast baseline ships every row to every peer each layer
+            "send_volume_per_exchange": int(
+                (self.plan.k - 1) * self.plan.part_sizes.sum()),
+        }
+        return report, out

@@ -74,11 +74,14 @@ def masked_softmax_xent_local(logits, labels, valid, axis_name: str = AXIS):
     local = -jnp.sum(picked * valid)
     total = lax.psum(local, axis_name)
     count = lax.psum(jnp.sum(valid), axis_name)
-    return total / count
+    # a (mini-)batch can contain zero valid train rows globally; 0/0 would
+    # poison the replicated weights with NaN for every later step
+    return total / jnp.maximum(count, 1.0)
 
 
 def masked_accuracy_local(logits, labels, valid, axis_name: str = AXIS):
     """Global accuracy over valid rows (every chip gets the same scalar)."""
     pred = jnp.argmax(logits, axis=-1)
     hits = jnp.sum((pred == labels) * valid)
-    return lax.psum(hits, axis_name) / lax.psum(jnp.sum(valid), axis_name)
+    count = lax.psum(jnp.sum(valid), axis_name)
+    return lax.psum(hits, axis_name) / jnp.maximum(count, 1.0)

@@ -1,4 +1,5 @@
-from .plan import CommPlan, build_comm_plan
+from .plan import CommPlan, build_comm_plan, pad_comm_plan, relabel_plan
 from .mesh import make_mesh_1d, shard_stacked, replicate
 
-__all__ = ["CommPlan", "build_comm_plan", "make_mesh_1d", "shard_stacked", "replicate"]
+__all__ = ["CommPlan", "build_comm_plan", "pad_comm_plan", "relabel_plan",
+           "make_mesh_1d", "shard_stacked", "replicate"]

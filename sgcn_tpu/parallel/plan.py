@@ -108,6 +108,95 @@ class CommPlan:
         return np.asarray(blocks)[self.owner, self.local_idx]
 
 
+def relabel_plan(a: sp.spmatrix, partvec: np.ndarray, k: int,
+                 pad_rows_to: int = 1) -> CommPlan:
+    """Vertex relabeling + padding fields only — no halo/send construction.
+
+    For algorithms with no boundary exchange (the broadcast-1D baseline ships
+    everything every layer), building the full halo plan is dead work; this
+    fills owner/local_idx/part_sizes/b/e/nnz/row_valid and leaves the comm
+    fields trivial.
+    """
+    a = sp.coo_matrix(a)
+    n = a.shape[0]
+    owner = np.asarray(partvec, dtype=np.int64)
+    if owner.shape[0] != n:
+        raise ValueError(f"partvec length {owner.shape[0]} != n {n}")
+    part_sizes = np.bincount(owner, minlength=k)
+    b = int(part_sizes.max()) if n else 1
+    b = max(1, -(-b // pad_rows_to) * pad_rows_to)
+    order = np.lexsort((np.arange(n), owner))
+    local_idx = np.empty(n, dtype=np.int64)
+    starts = np.zeros(k + 1, dtype=np.int64)
+    np.cumsum(part_sizes, out=starts[1:])
+    local_idx[order] = np.arange(n) - starts[owner[order]]
+    nnz = np.bincount(owner[a.row], minlength=k)
+    e = max(1, int(nnz.max()) if len(nnz) else 1)
+    row_valid = np.zeros((k, b), dtype=np.float32)
+    for p in range(k):
+        row_valid[p, : part_sizes[p]] = 1.0
+    z = np.zeros
+    return CommPlan(
+        n=n, k=k, b=b, s=1, r=1, e=e,
+        owner=owner, local_idx=local_idx,
+        part_sizes=part_sizes.astype(np.int64),
+        send_idx=z((k, k, 1), np.int32), send_counts=z((k, k), np.int32),
+        halo_src=z((k, 1), np.int32), halo_counts=z(k, np.int32),
+        edge_dst=z((k, e), np.int32), edge_src=z((k, e), np.int32),
+        edge_w=z((k, e), np.float32), nnz=nnz.astype(np.int64),
+        row_valid=row_valid,
+    )
+
+
+def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int) -> CommPlan:
+    """Re-pad a plan to a larger (B, S, R, E) envelope.
+
+    Lets many plans (one per mini-batch) share ONE compiled train step: the
+    reference pre-samples all batches and builds per-batch comm maps up front
+    (``GPU/PGCN-Mini-batch.py:220-230``); under XLA the analogous move is
+    padding every batch plan to the max envelope so shapes are static
+    (SURVEY.md §7.3).  Padding preserves the plan invariants: pad edges carry
+    weight 0 and dst ``b-1`` (keeps ``edge_dst`` non-decreasing), pad send /
+    halo slots index row 0 and are never read by valid gathers.
+    """
+    if (b, s, r, e) == (plan.b, plan.s, plan.r, plan.e):
+        return plan
+    if b < plan.b or s < plan.s or r < plan.r or e < plan.e:
+        raise ValueError("pad_comm_plan cannot shrink an envelope")
+    k = plan.k
+
+    send_idx = np.zeros((k, k, s), dtype=np.int32)
+    send_idx[:, :, : plan.s] = plan.send_idx
+    halo_src = np.zeros((k, r), dtype=np.int32)
+    # remap old flat recv slots q*S_old + t -> q*S_new + t
+    q_old, t_old = plan.halo_src // plan.s, plan.halo_src % plan.s
+    halo_src[:, : plan.r] = (q_old * s + t_old).astype(np.int32)
+    edge_dst = np.full((k, e), b - 1, dtype=np.int32)
+    edge_dst[:, : plan.e] = plan.edge_dst
+    # old pad edges pointed at plan.b-1; retarget them to b-1 to keep the
+    # non-decreasing invariant (weight 0 either way)
+    for p in range(k):
+        edge_dst[p, plan.nnz[p]: plan.e] = b - 1
+    edge_src = np.zeros((k, e), dtype=np.int32)
+    # halo table shifts from plan.b to b: remap src indices >= plan.b
+    old_src = plan.edge_src
+    edge_src[:, : plan.e] = np.where(
+        old_src >= plan.b, old_src - plan.b + b, old_src)
+    edge_w = np.zeros((k, e), dtype=np.float32)
+    edge_w[:, : plan.e] = plan.edge_w
+    row_valid = np.zeros((k, b), dtype=np.float32)
+    row_valid[:, : plan.b] = plan.row_valid
+
+    return CommPlan(
+        n=plan.n, k=k, b=b, s=s, r=r, e=e,
+        owner=plan.owner, local_idx=plan.local_idx, part_sizes=plan.part_sizes,
+        send_idx=send_idx, send_counts=plan.send_counts.copy(),
+        halo_src=halo_src, halo_counts=plan.halo_counts.copy(),
+        edge_dst=edge_dst, edge_src=edge_src, edge_w=edge_w,
+        nnz=plan.nnz.copy(), row_valid=row_valid,
+    )
+
+
 def build_comm_plan(
     a: sp.spmatrix,
     partvec: np.ndarray,

@@ -1,0 +1,12 @@
+from .model import (
+    communication_volume,
+    generate_stochastic_hypergraph,
+    run_shp,
+    sample_sparse_submatrix,
+    simulate,
+)
+
+__all__ = [
+    "communication_volume", "generate_stochastic_hypergraph", "run_shp",
+    "sample_sparse_submatrix", "simulate",
+]

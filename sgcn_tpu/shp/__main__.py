@@ -1,0 +1,42 @@
+"""SHP CLI — role of ``python SHP/main.py -p A.mtx -k K -s S -b B -h H -o OUT``
+(``GPU/SHP/main.py:96-129``; the sampled-batch count flag is ``-m`` here since
+``-h`` is taken by help).  Pickles both part vectors as ``partvec.hp.<k>`` and
+``partvec.stchp.<k>`` (``:131-140``), the format ``PGCN-Mini-batch`` consumes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from ..io.mtx import read_mtx
+from ..partition.emit import write_partvec_pickle
+from .model import run_shp
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="stochastic hypergraph partitioner")
+    p.add_argument("-p", "--path", required=True, help="adjacency .mtx")
+    p.add_argument("-k", "--nparts", type=int, required=True)
+    p.add_argument("-s", "--sim-iters", type=int, default=20)
+    p.add_argument("-b", "--batch-size", type=int, default=256)
+    p.add_argument("-m", "--sampled-batches", type=int, default=10,
+                   help="batches hstacked into the stochastic hypergraph")
+    p.add_argument("-o", "--outdir", default=".")
+    p.add_argument("-e", "--imbalance", type=float, default=0.03)
+    p.add_argument("--seed", type=int, default=1)
+    args = p.parse_args()
+
+    a = read_mtx(args.path)
+    res = run_shp(a, args.nparts, args.sampled_batches, args.batch_size,
+                  args.sim_iters, args.imbalance, args.seed)
+    os.makedirs(args.outdir, exist_ok=True)
+    for name in ("hp", "stchp"):
+        out = os.path.join(args.outdir, f"partvec.{name}.{args.nparts}")
+        write_partvec_pickle(out, res[f"partvec_{name}"])
+        print(f"{name}: {out}  km1={res[f'km1_{name}']}  "
+              f"sim_comm_volume={res[f'sim_comm_volume_{name}']}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Stochastic hypergraph partitioning (SHP) — mini-batch-aware partitions.
+
+Reference: ``GPU/SHP/main.py``.  The idea: a partition minimizing *full-graph*
+connectivity is not optimal for *mini-batch* training, where each step only
+touches a random vertex subset.  SHP builds a "stochastic hypergraph" by
+horizontally stacking the column-nets of ``h`` sampled batch submatrices
+(``generate_stochastic_hypergraph`` ``:64-72``), partitions THAT with the
+column-net km1 objective (KaHyPar there, our native partitioner here,
+``partitionColNet`` ``:17-32``), and validates by simulating ``s`` random
+batches and comparing expected communication volume against the baseline
+full-graph hypergraph partition (``simulate`` ``:85-93``).
+
+All sampling is vectorized numpy; partitioning is the native C++ multilevel
+colnet partitioner (``sgcn_tpu.partition.native``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..partition.native import partition_hypergraph_colnet
+
+
+def sample_sparse_submatrix(a: sp.spmatrix, batch_size: int,
+                            rng: np.random.Generator) -> sp.csc_matrix:
+    """Batch-restricted submatrix, global row space, empty columns dropped
+    (``GPU/SHP/main.py:44-62``): keep nonzeros whose row AND col are in a
+    random ``batch_size``-vertex subset."""
+    a = sp.coo_matrix(a)
+    n = a.shape[0]
+    sub = rng.choice(n, size=min(batch_size, n), replace=False)
+    member = np.zeros(n, dtype=bool)
+    member[sub] = True
+    keep = member[a.row] & member[a.col]
+    s = sp.csc_matrix(
+        (a.data[keep], (a.row[keep], a.col[keep])), shape=a.shape)
+    nonempty = np.diff(s.indptr) != 0
+    return s[:, nonempty]
+
+
+def generate_stochastic_hypergraph(a: sp.spmatrix, nbatches: int,
+                                   batch_size: int,
+                                   rng: np.random.Generator) -> sp.csc_matrix:
+    """hstack of sampled batch submatrices: rows = cells (vertices), columns =
+    nets drawn from the batch distribution (``GPU/SHP/main.py:64-72``)."""
+    subs = [sample_sparse_submatrix(a, batch_size, rng)
+            for _ in range(nbatches)]
+    return sp.csc_matrix(sp.hstack(subs))
+
+
+def communication_volume(s: sp.spmatrix, partvec: np.ndarray) -> int:
+    """Σ over columns of (distinct parts touching the column − 1)
+    (``GPU/SHP/main.py:74-83``), vectorized via unique (col, part) pairs."""
+    s = sp.coo_matrix(s)
+    if s.nnz == 0:
+        return 0
+    pv = np.asarray(partvec)
+    pairs = s.col.astype(np.int64) * (pv.max() + 1) + pv[s.row]
+    n_pairs = len(np.unique(pairs))
+    n_cols = len(np.unique(s.col))
+    return int(n_pairs - n_cols)
+
+
+def simulate(a: sp.spmatrix, partvecs: dict[str, np.ndarray], niter: int,
+             batch_size: int, rng: np.random.Generator) -> dict[str, int]:
+    """Expected batch comm volume per partvec over ``niter`` sampled batches
+    (``GPU/SHP/main.py:85-93``)."""
+    totals = {name: 0 for name in partvecs}
+    for _ in range(niter):
+        s = sample_sparse_submatrix(a, batch_size, rng)
+        for name, pv in partvecs.items():
+            totals[name] += communication_volume(s, pv)
+    return totals
+
+
+def run_shp(
+    a: sp.spmatrix,
+    k: int,
+    nsampled_batches: int = 10,
+    batch_size: int = 256,
+    sim_iters: int = 20,
+    imbalance: float = 0.03,
+    seed: int = 1,
+) -> dict:
+    """Full SHP pipeline: baseline HP partition, stochastic HP partition,
+    batch-comm simulation of both (``GPU/SHP/main.py:96-140``)."""
+    a = sp.csr_matrix(a)
+    rng = np.random.default_rng(seed)
+    pv_hp, km1_hp = partition_hypergraph_colnet(a, k, imbalance, seed)
+    stc = generate_stochastic_hypergraph(a, nsampled_batches, batch_size, rng)
+    pv_stchp, km1_stc = partition_hypergraph_colnet(
+        sp.csr_matrix(stc), k, imbalance, seed)
+    sim = simulate(a, {"hp": pv_hp, "stchp": pv_stchp}, sim_iters,
+                   batch_size, rng)
+    return {
+        "partvec_hp": pv_hp,
+        "partvec_stchp": pv_stchp,
+        "km1_hp": km1_hp,
+        "km1_stchp": km1_stc,
+        "sim_comm_volume_hp": sim["hp"],
+        "sim_comm_volume_stchp": sim["stchp"],
+    }

@@ -1,0 +1,127 @@
+"""Trainer CLI — flag-compatible with the reference's PGCN/PGAT family.
+
+Reference: ``python PGCN.py -a A.mtx -p partvec -b nccl|gloo -s size -l layers
+-f features`` (``README.md:92``, ``GPU/PGCN.py:262-278``); ``PGCN-Mini-batch``
+adds ``-n batch_size``; ``PGAT.py`` is the attention flavor.  Here one CLI
+covers all four trainers:
+
+  * ``-b jax``  — run on the platform's real devices (TPU mesh), the
+    NCCL-equivalent backend per ``BASELINE.json``;
+  * ``-b cpu``  — force ``-s`` virtual host CPU devices, the Gloo-equivalent
+    "cluster on one box" mode (``GPU/PGCN.py:166-169``);
+  * ``--model gat`` — PGAT;  ``-n BATCH`` — PGCN-Mini-batch.
+
+Without ``--features-mtx/--labels-mtx`` the synthetic benchmark harness inputs
+are used, like the reference benchmark scripts: ``H[i] = [i]·f`` and
+``labels = arange % f`` (``GPU/PGCN.py:186-192``).
+
+The backend env setup must happen before JAX initializes, so heavy imports
+are deferred into ``main`` after arg parsing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="sgcn_tpu distributed trainer")
+    p.add_argument("-a", "--adjacency", required=True, help=".mtx adjacency")
+    p.add_argument("-p", "--partvec", required=True,
+                   help="part vector: text (.gp/.hp/.rp) or pickle")
+    p.add_argument("-b", "--backend", default="jax", choices=["jax", "cpu"])
+    p.add_argument("-s", "--nparts", type=int, required=True)
+    p.add_argument("-l", "--nlayers", type=int, default=2)
+    p.add_argument("-f", "--nfeatures", type=int, default=16)
+    p.add_argument("-n", "--batch-size", type=int, default=None,
+                   help="enable the mini-batch trainer")
+    p.add_argument("--model", default="gcn", choices=["gcn", "gat"])
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--hidden", type=int, default=None,
+                   help="hidden width (default: nfeatures)")
+    p.add_argument("--normalize", action="store_true",
+                   help="apply Â normalization to the input adjacency")
+    p.add_argument("--features-mtx", default=None)
+    p.add_argument("--labels-mtx", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    if args.backend == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={args.nparts}"
+            ).strip()
+
+    import jax
+    if args.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from ..io.mtx import read_mtx
+    from ..parallel.plan import build_comm_plan
+    from ..partition.emit import read_partvec, read_partvec_pickle
+    from ..prep import normalize_adjacency
+    from .fullbatch import FullBatchTrainer, make_train_data
+    from .minibatch import MiniBatchTrainer
+
+    a = read_mtx(args.adjacency)
+    if args.normalize:
+        a = normalize_adjacency(a)
+    n = a.shape[0]
+    try:
+        pv = read_partvec(args.partvec)
+    except (UnicodeDecodeError, ValueError):
+        pv = read_partvec_pickle(args.partvec)
+    if len(pv) != n:
+        raise SystemExit(f"partvec length {len(pv)} != n {n}")
+    k = args.nparts
+    if pv.max() >= k:
+        raise SystemExit(f"partvec references part {pv.max()} >= k {k}")
+
+    f = args.nfeatures
+    if args.features_mtx:
+        feats = np.asarray(read_mtx(args.features_mtx).todense(), np.float32)
+        f = feats.shape[1]
+    else:
+        # synthetic benchmark harness inputs (GPU/PGCN.py:186-192)
+        feats = np.tile(np.arange(n, dtype=np.float32)[:, None], (1, f))
+    if args.labels_mtx:
+        labels = np.asarray(read_mtx(args.labels_mtx).todense()).argmax(1)
+        nclasses = int(labels.max()) + 1
+    else:
+        labels = np.arange(n) % f
+        nclasses = f
+    labels = labels.astype(np.int32)
+
+    hidden = args.hidden or f
+    widths = [hidden] * (args.nlayers - 1) + [nclasses]
+
+    if args.batch_size is not None:
+        tr = MiniBatchTrainer(a, pv, k, fin=f, widths=widths,
+                              batch_size=args.batch_size, lr=args.lr,
+                              model=args.model, seed=args.seed)
+        report = tr.fit(feats, labels, epochs=args.epochs,
+                        warmup=args.warmup)
+    else:
+        plan = build_comm_plan(a, pv, k)
+        tr = FullBatchTrainer(plan, fin=f, widths=widths, lr=args.lr,
+                              model=args.model, seed=args.seed)
+        data = make_train_data(plan, feats, labels)
+        report = tr.fit(data, epochs=args.epochs, warmup=args.warmup)
+
+    # rank-0-style end-of-run line (GPU/PGCN.py:226-238)
+    report["backend"] = args.backend
+    report["model"] = args.model
+    report.pop("loss_history", None)
+    print(json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    main()

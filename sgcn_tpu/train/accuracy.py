@@ -1,0 +1,84 @@
+"""Accuracy-parity experiment — does partitioning change predictive power?
+
+Reference: ``GPU/PGCN-Accuracy.py`` (run on cora, ``README.md:110``): train the
+partitioned model on real features/labels with a train/test split and check
+the predictive performance matches non-partitioned training.  The reference
+restricts per-batch communication to ``boundary ∩ batch``
+(``:92-139,112-128``); in our mini-batch trainer that restriction is
+structural (batch plans only exchange boundary-of-batch rows).
+
+This module is the experiment harness: it trains (a) the single-device dense
+oracle (DGL-baseline role), (b) the distributed full-batch trainer, and
+optionally (c) the distributed mini-batch trainer, all from the same init
+seed, and reports test accuracy for each.  The parity assertion itself lives
+in the test suite (SURVEY.md §4: the reference's notion of correctness).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..baselines.oracle import DenseOracle
+from ..parallel.plan import build_comm_plan
+from .fullbatch import FullBatchTrainer, make_train_data
+from .minibatch import MiniBatchTrainer
+
+
+def train_test_split_masks(n: int, train_frac: float = 0.6,
+                           seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Random vertex-level split (the reference uses fixed random batches of
+    256 for training and the rest for testing, ``GPU/PGCN-Accuracy.py:228-251``)."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    ntrain = int(n * train_frac)
+    train = np.zeros(n, dtype=np.float32)
+    test = np.zeros(n, dtype=np.float32)
+    train[perm[:ntrain]] = 1.0
+    test[perm[ntrain:]] = 1.0
+    return train, test
+
+
+def run_accuracy_parity(
+    a: sp.spmatrix,
+    features: np.ndarray,
+    labels: np.ndarray,
+    partvec: np.ndarray,
+    k: int,
+    widths: list[int],
+    train_mask: np.ndarray,
+    test_mask: np.ndarray,
+    epochs: int = 15,
+    batch_size: int | None = None,
+    lr: float = 0.01,
+    seed: int = 0,
+    verbose: bool = False,
+) -> dict:
+    """Train oracle + distributed trainers on the same split; report test acc."""
+    n = a.shape[0]
+    fin = features.shape[1]
+    results: dict = {}
+
+    oracle = DenseOracle(a, fin, widths, lr=lr, seed=seed)
+    for _ in range(epochs):
+        oracle.step(features, labels, train_mask)
+    pred = oracle.predict(features).argmax(axis=1)
+    results["oracle_test_acc"] = float(
+        ((pred == labels) * test_mask).sum() / test_mask.sum())
+
+    plan = build_comm_plan(a, partvec, k)
+    tr = FullBatchTrainer(plan, fin, widths, lr=lr, seed=seed)
+    data = make_train_data(plan, features, labels, train_mask, test_mask)
+    for _ in range(epochs):
+        tr.step(data)
+    _, acc = tr.evaluate(data)
+    results["fullbatch_test_acc"] = float(acc)
+
+    if batch_size is not None:
+        mb = MiniBatchTrainer(a, partvec, k, fin, widths,
+                              batch_size=batch_size, lr=lr, seed=seed)
+        mb.fit(features, labels, train_mask, epochs=epochs, verbose=verbose)
+        _, acc = mb.evaluate_fullgraph(features, labels, test_mask)
+        results["minibatch_test_acc"] = float(acc)
+
+    return results

@@ -1,0 +1,188 @@
+"""Mini-batch distributed GCN trainer — per-batch sampled adjacency + plans.
+
+Reference: ``GPU/PGCN-Mini-batch.py`` — pre-samples ``nbatches = 3·(n/batch+1)``
+random vertex subsets before training (``:220-230``), builds a per-batch
+sampled adjacency restricted to the batch (``sample_adjacency_matrix``
+``:58-69``) and per-batch comm maps (``:228``), then loops batches through a
+fixed layer stack; its partition vector comes from SHP as a pickle
+(``:217-218``).  ``GPU/PGCN-Accuracy.py`` is the variant with real labels and
+comm restricted to ``boundary ∩ batch`` (``:92-139``) — here that restriction
+is structural: batch plans are built from the batch subgraph, so only
+boundary-of-batch rows are exchanged, and training on a batch touches only
+batch vertices.
+
+TPU design: per-batch nnz/halo sizes vary, which under XLA would mean one
+compilation per batch.  Every batch plan is therefore padded to the max
+envelope across batches (``pad_comm_plan``) so ONE jitted shard_map train step
+serves every batch — the XLA-native mirror of the reference's
+pre-sample-everything strategy (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+import optax
+import scipy.sparse as sp
+
+from ..parallel.mesh import make_mesh_1d, shard_stacked
+from ..parallel.plan import build_comm_plan, pad_comm_plan
+from .fullbatch import FullBatchTrainer, TrainData, _plan_arrays, make_train_data
+
+
+def sample_batches(n: int, batch_size: int, nbatches: int | None = None,
+                   seed: int = 0) -> list[np.ndarray]:
+    """Pre-sample vertex subsets; default count = 3·(n//batch + 1)
+    (``GPU/PGCN-Mini-batch.py:220-230``)."""
+    rng = np.random.default_rng(seed)
+    if nbatches is None:
+        nbatches = 3 * (n // batch_size + 1)
+    batch_size = min(batch_size, n)
+    return [np.sort(rng.choice(n, size=batch_size, replace=False))
+            for _ in range(nbatches)]
+
+
+def sample_adjacency(a: sp.spmatrix, batch: np.ndarray) -> sp.csr_matrix:
+    """Batch-restricted adjacency ``A[batch][:, batch]`` reindexed to
+    ``0..|batch|-1`` (``GPU/PGCN-Mini-batch.py:58-69``)."""
+    a = sp.csr_matrix(a)
+    return a[batch][:, batch]
+
+
+@dataclass
+class Batch:
+    vertices: np.ndarray
+    plan: object          # padded CommPlan over the batch subgraph
+    pa: dict              # sharded plan arrays
+    data: TrainData       # sharded per-chip batch blocks
+
+
+class MiniBatchTrainer:
+    """PGCN-Mini-batch-equivalent trainer on the 1D vertex mesh."""
+
+    def __init__(
+        self,
+        a: sp.spmatrix,
+        partvec: np.ndarray,
+        k: int,
+        fin: int,
+        widths: list[int],
+        batch_size: int,
+        nbatches: int | None = None,
+        mesh=None,
+        lr: float = 0.01,
+        activation: str = "relu",
+        model: str = "gcn",
+        optimizer: optax.GradientTransformation | None = None,
+        seed: int = 0,
+        pad_rows_to: int = 8,
+    ):
+        self.a = sp.csr_matrix(a)
+        n = self.a.shape[0]
+        self.partvec = np.asarray(partvec, dtype=np.int64)
+        self.k = k
+        self.mesh = mesh if mesh is not None else make_mesh_1d(k)
+        self.batches_idx = sample_batches(n, batch_size, nbatches, seed=seed)
+
+        # build per-batch plans, then pad all to the shared envelope
+        raw = []
+        for bv in self.batches_idx:
+            sub = sample_adjacency(self.a, bv)
+            pv = self.partvec[bv]
+            # remap part ids unchanged: chips keep their global rank even if a
+            # batch misses some part entirely
+            raw.append(build_comm_plan(sub, pv, k, pad_rows_to=pad_rows_to))
+        env = tuple(max(getattr(p, f) for p in raw) for f in ("b", "s", "r", "e"))
+        self.plans = [pad_comm_plan(p, *env) for p in raw]
+
+        # one inner trainer = one compiled step for every batch
+        self.inner = FullBatchTrainer(
+            self.plans[0], fin, widths, mesh=self.mesh, lr=lr,
+            activation=activation, model=model, optimizer=optimizer, seed=seed)
+        self.total_exchanged_rows = 0
+        self.nlayers = len(widths)
+        self._fullgraph_eval = None   # built lazily, cached across calls
+
+    # ------------------------------------------------------------------- data
+    def make_batches(self, features: np.ndarray, labels: np.ndarray,
+                     train_mask: np.ndarray | None = None) -> list[Batch]:
+        """Scatter global features/labels into per-batch per-chip blocks."""
+        out = []
+        for bv, plan in zip(self.batches_idx, self.plans):
+            tm = train_mask[bv] if train_mask is not None else None
+            data = make_train_data(plan, features[bv], labels[bv], tm)
+            out.append(Batch(
+                vertices=bv,
+                plan=plan,
+                pa=shard_stacked(self.mesh, _plan_arrays(plan)),
+                data=TrainData(**shard_stacked(self.mesh, vars(data))),
+            ))
+        return out
+
+    # ------------------------------------------------------------------- api
+    def step(self, batch: Batch) -> float:
+        tr = self.inner
+        tr.params, tr.opt_state, loss = tr._step(
+            tr.params, tr.opt_state, batch.pa, batch.data.h0,
+            batch.data.labels, batch.data.train_valid)
+        self.total_exchanged_rows += 2 * self.nlayers * int(
+            batch.plan.predicted_send_volume.sum())
+        return float(loss)
+
+    def fit(self, features: np.ndarray, labels: np.ndarray,
+            train_mask: np.ndarray | None = None, epochs: int = 1,
+            warmup: int = 1, verbose: bool = True) -> dict:
+        """Epoch = one pass over all pre-sampled batches (reference epoch
+        structure, ``GPU/PGCN-Mini-batch.py:231-306``)."""
+        batches = self.make_batches(features, labels, train_mask)
+        for _ in range(warmup):
+            self.step(batches[0])
+        jax.block_until_ready(self.inner.params)
+        history = []
+        t0 = time.perf_counter()
+        for ep in range(epochs):
+            ep_loss = 0.0
+            for b in batches:
+                ep_loss += self.step(b)
+            ep_loss /= len(batches)
+            history.append(ep_loss)
+            if verbose:
+                print(f"epoch {ep}: batch-avg loss {ep_loss:.6f}", flush=True)
+        jax.block_until_ready(self.inner.params)
+        elapsed = time.perf_counter() - t0
+        return {
+            "epochs": epochs,
+            "nbatches": len(batches),
+            "elapsed_s": elapsed,
+            "epoch_s": elapsed / max(epochs, 1),
+            "loss_history": history,
+            "total_exchanged_rows": self.total_exchanged_rows,
+        }
+
+    # full-graph evaluation path (accuracy-parity experiments evaluate on the
+    # whole graph after mini-batch training — GPU/PGCN-Accuracy.py role)
+    def evaluate_fullgraph(self, features: np.ndarray, labels: np.ndarray,
+                           eval_mask: np.ndarray | None = None):
+        if self._fullgraph_eval is None:
+            plan = build_comm_plan(self.a, self.partvec, self.k)
+            self._fullgraph_eval = (plan, FullBatchTrainer(
+                plan, features.shape[1], self._widths_from_params(),
+                mesh=self.mesh, activation=self.inner.activation,
+                model=self.inner.model))
+        plan, tr = self._fullgraph_eval
+        tr.params = self.inner.params
+        data = make_train_data(plan, features, labels,
+                               np.ones(self.a.shape[0], np.float32),
+                               eval_mask)
+        data = TrainData(**shard_stacked(self.mesh, vars(data)))
+        loss, acc, _ = tr._eval(tr.params, tr.pa, data.h0, data.labels,
+                                data.eval_valid)
+        return float(loss), float(acc)
+
+    def _widths_from_params(self) -> list[int]:
+        if self.inner.model == "gcn":
+            return [int(w.shape[1]) for w in self.inner.params]
+        return [int(p["w"].shape[1]) for p in self.inner.params]

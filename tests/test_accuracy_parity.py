@@ -1,0 +1,49 @@
+"""The reference's correctness criterion: partitioned training must match
+non-partitioned predictive performance (GPU/PGCN-Accuracy.py, README.md:110)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from sgcn_tpu.partition import balanced_random_partition
+from sgcn_tpu.prep import normalize_adjacency
+from sgcn_tpu.train.accuracy import run_accuracy_parity, train_test_split_masks
+
+
+def planted_graph(n=96, nclasses=3, p_in=0.25, p_out=0.02, seed=0):
+    """Community graph whose labels a GCN can actually learn."""
+    rng = np.random.default_rng(seed)
+    labels = (np.arange(n) % nclasses).astype(np.int32)
+    prob = np.where(labels[:, None] == labels[None, :], p_in, p_out)
+    dense = rng.random((n, n)) < prob
+    dense = np.triu(dense, 1)
+    dense = dense | dense.T
+    a = sp.csr_matrix(dense.astype(np.float32))
+    feats = np.eye(nclasses, dtype=np.float32)[labels]
+    feats = feats + rng.normal(0, 0.4, (n, nclasses)).astype(np.float32)
+    return a, feats, labels
+
+
+def test_split_masks_disjoint():
+    tr, te = train_test_split_masks(50, 0.6, seed=1)
+    assert tr.sum() == 30 and te.sum() == 20
+    assert (tr * te).sum() == 0
+
+
+def test_accuracy_parity_full_and_minibatch():
+    a, feats, labels = planted_graph()
+    ahat = normalize_adjacency(a)
+    n = a.shape[0]
+    pv = balanced_random_partition(n, 4, seed=2)
+    train, test = train_test_split_masks(n, 0.6, seed=3)
+    res = run_accuracy_parity(
+        ahat, feats, labels, pv, k=4, widths=[16, 3],
+        train_mask=train, test_mask=test, epochs=30, lr=0.05,
+        batch_size=48, seed=0)
+    # the graph is learnable at all
+    assert res["oracle_test_acc"] > 0.6
+    # partitioned full-batch IS the same computation — tight parity
+    assert abs(res["fullbatch_test_acc"] - res["oracle_test_acc"]) < 0.05
+    # mini-batch sees subsampled neighborhoods — allow a wider band but it
+    # must stay in the same quality regime (the reference's claim)
+    assert res["minibatch_test_acc"] > res["oracle_test_acc"] - 0.15

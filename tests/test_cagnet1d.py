@@ -1,0 +1,51 @@
+"""Broadcast-1D baseline parity: must equal the dense oracle and the
+partitioned path layer math (same Â, same weights) — SURVEY.md §2.3's
+"1D uniform broadcast" row."""
+
+import numpy as np
+
+from sgcn_tpu.baselines.cagnet1d import BroadcastGCN1D
+from sgcn_tpu.baselines.oracle import DenseOracle
+from sgcn_tpu.partition import balanced_random_partition
+
+K = 4
+
+
+def test_broadcast_matches_oracle(ahat):
+    n = ahat.shape[0]
+    rng = np.random.default_rng(0)
+    feats = rng.standard_normal((n, 10)).astype(np.float32)
+    pv = balanced_random_partition(n, K, seed=2)
+    bc = BroadcastGCN1D(ahat, pv, K, fin=10, widths=[8, 3],
+                        activation="sigmoid", seed=4)
+    oracle = DenseOracle(ahat, fin=10, widths=[8, 3],
+                         activation="sigmoid", final_activation="sigmoid",
+                         seed=4)
+    got = bc.forward(feats)
+    want = oracle.predict(feats)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_broadcast_phase_report(ahat):
+    n = ahat.shape[0]
+    feats = np.random.default_rng(1).standard_normal((n, 6)).astype(np.float32)
+    pv = balanced_random_partition(n, K, seed=2)
+    bc = BroadcastGCN1D(ahat, pv, K, fin=6, widths=[4], seed=0)
+    report, out = bc.run_epochs(feats, epochs=2)
+    assert out.shape == (n, 4)
+    assert report["epochs"] == 2
+    assert "data_comm" in report["phases"] and "local_spmm" in report["phases"]
+    # 2 epochs x 1 layer
+    assert report["phases"]["data_comm"]["count"] == 2
+    # broadcast volume is worse than any halo plan: (k-1) * n rows per layer
+    assert report["send_volume_per_exchange"] == (K - 1) * n
+
+
+def test_broadcast_fused_matches_unfused(ahat):
+    n = ahat.shape[0]
+    feats = np.random.default_rng(2).standard_normal((n, 6)).astype(np.float32)
+    pv = balanced_random_partition(n, K, seed=5)
+    a = BroadcastGCN1D(ahat, pv, K, fin=6, widths=[5, 3], seed=7)
+    b = BroadcastGCN1D(ahat, pv, K, fin=6, widths=[5, 3], seed=7, fused=True)
+    np.testing.assert_allclose(a.forward(feats), b.forward(feats),
+                               rtol=1e-5, atol=1e-6)
