@@ -150,6 +150,8 @@ class BroadcastGCN1D:
                    epochs: int = 5) -> tuple[dict, np.ndarray]:
         """Reference protocol: repeated forward passes, phase times reported
         (``Cagnet/main.c:125-220,395-413``)."""
+        if epochs < 1:
+            raise ValueError("epochs must be >= 1")
         t0 = time.perf_counter()
         for _ in range(epochs):
             out = self.forward(features)

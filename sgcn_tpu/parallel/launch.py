@@ -1,0 +1,122 @@
+"""Multi-host launch: process bootstrap + global mesh over ICI/DCN.
+
+Reference equivalents: the SLURM rendezvous plumbing — ``MASTER_ADDR`` /
+``MASTER_PORT`` derived from the job id and nodelist, ``WORLD_SIZE`` =
+nodes × tasks (``GPU/pytorch.3node.slurm:46-56``), consumed by
+``dist.init_process_group`` via ``SLURM_NPROCS``/``SLURM_PROCID``
+(``GPU/PGCN.py:241-260``).
+
+TPU-native shape: one Python process per host, ``jax.distributed.initialize``
+for the rendezvous (it auto-detects on Cloud TPU pods; SLURM env vars are the
+fallback), and a single global 1D vertex mesh over ALL chips of all hosts.
+Collectives between co-located chips ride ICI; cross-host hops ride DCN —
+the same topology split as the reference's NCCL intra/inter-node rings, but
+chosen by XLA's collective scheduler rather than hand-written P2P.
+
+Every sgcn_tpu trainer takes an explicit ``mesh``; launching multi-host is
+therefore just::
+
+    ctx = init_distributed()                  # once per process, before use
+    mesh = global_mesh_1d()                   # k = total chips in the job
+    trainer = FullBatchTrainer(plan, fin, widths, mesh=mesh)
+
+with data created per-host through the same ``make_train_data`` (jax.Array
+sharding moves each chip's block to its owner automatically on
+``device_put``).  See ``launch/tpu.slurm`` for the batch-script equivalent of
+the reference's ``pytorch.3node.slurm``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+
+from .mesh import AXIS, make_mesh_1d
+
+
+@dataclass
+class DistributedContext:
+    process_id: int
+    num_processes: int
+    coordinator: str | None
+    local_devices: int
+    global_devices: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Rank-0 check — all end-of-run printing is rank-0-only in the
+        reference (``GPU/PGCN.py:230-238``)."""
+        return self.process_id == 0
+
+
+def slurm_rendezvous_env() -> tuple[str, int, int] | None:
+    """Derive (coordinator, num_processes, process_id) from SLURM variables,
+    mirroring the reference's launcher arithmetic
+    (``GPU/pytorch.3node.slurm:46-56``: port = 10000 + last 4 digits of the
+    job id; master = first node of the nodelist — here the caller passes the
+    resolved hostname via ``SGCN_COORDINATOR`` or ``MASTER_ADDR``)."""
+    nprocs = os.environ.get("SLURM_NPROCS")
+    procid = os.environ.get("SLURM_PROCID")
+    if nprocs is None or procid is None:
+        return None
+    addr = (os.environ.get("SGCN_COORDINATOR")
+            or os.environ.get("MASTER_ADDR"))
+    if addr is None:
+        return None
+    port = os.environ.get("MASTER_PORT")
+    if port is None:
+        jobid = os.environ.get("SLURM_JOBID", "0")
+        port = str(10000 + int(jobid[-4:] or 0))
+    return f"{addr}:{port}", int(nprocs), int(procid)
+
+
+def init_distributed(coordinator: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> DistributedContext:
+    """Bootstrap multi-process JAX.  Single-process (the common dev case and
+    the one-chip bench) is a no-op that still returns a valid context.
+
+    Resolution order: explicit args → Cloud TPU autodetection (no env needed)
+    → SLURM env (reference-style cluster).
+    """
+    if num_processes is None:
+        env = slurm_rendezvous_env()
+        if env is not None:
+            coordinator, num_processes, process_id = env
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif num_processes is None:
+        # Cloud TPU pod: fully autodetected — only when there genuinely are
+        # multiple workers (single-worker boxes also set TPU_WORKER_HOSTNAMES)
+        hosts = [h for h in os.environ.get(
+            "TPU_WORKER_HOSTNAMES", "").split(",") if h]
+        if len(hosts) > 1:
+            jax.distributed.initialize()
+    return DistributedContext(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        coordinator=coordinator,
+        local_devices=jax.local_device_count(),
+        global_devices=jax.device_count(),
+    )
+
+
+def global_mesh_1d(k: int | None = None):
+    """1D vertex mesh over every chip in the job (all hosts).
+
+    Device order follows ``jax.devices()`` — co-located chips are adjacent,
+    so neighboring parts land on ICI-connected chips and only part-boundary
+    traffic that crosses hosts rides DCN.
+    """
+    devs = jax.devices()
+    return make_mesh_1d(k if k is not None else len(devs), devices=devs)
+
+
+__all__ = ["DistributedContext", "init_distributed", "global_mesh_1d",
+           "slurm_rendezvous_env", "AXIS"]

@@ -108,6 +108,31 @@ class CommPlan:
         return np.asarray(blocks)[self.owner, self.local_idx]
 
 
+def _relabel(n: int, partvec: np.ndarray, k: int, pad_rows_to: int):
+    """Shared vertex relabeling: (owner, local_idx, part_sizes, b, row_valid).
+
+    Chip ``p`` owns local slots 0..B-1, vertices ranked by global id within
+    their part; single source of truth for both plan builders below.
+    """
+    owner = np.asarray(partvec, dtype=np.int64)
+    if owner.shape[0] != n:
+        raise ValueError(f"partvec length {owner.shape[0]} != n {n}")
+    if n and (owner.min() < 0 or owner.max() >= k):
+        raise ValueError("partvec entries out of range")
+    part_sizes = np.bincount(owner, minlength=k)
+    b = int(part_sizes.max()) if n else 1
+    b = max(1, -(-b // pad_rows_to) * pad_rows_to)
+    order = np.lexsort((np.arange(n), owner))
+    local_idx = np.empty(n, dtype=np.int64)
+    starts = np.zeros(k + 1, dtype=np.int64)
+    np.cumsum(part_sizes, out=starts[1:])
+    local_idx[order] = np.arange(n) - starts[owner[order]]
+    row_valid = np.zeros((k, b), dtype=np.float32)
+    for p in range(k):
+        row_valid[p, : part_sizes[p]] = 1.0
+    return owner, local_idx, part_sizes, b, row_valid
+
+
 def relabel_plan(a: sp.spmatrix, partvec: np.ndarray, k: int,
                  pad_rows_to: int = 1) -> CommPlan:
     """Vertex relabeling + padding fields only — no halo/send construction.
@@ -119,22 +144,10 @@ def relabel_plan(a: sp.spmatrix, partvec: np.ndarray, k: int,
     """
     a = sp.coo_matrix(a)
     n = a.shape[0]
-    owner = np.asarray(partvec, dtype=np.int64)
-    if owner.shape[0] != n:
-        raise ValueError(f"partvec length {owner.shape[0]} != n {n}")
-    part_sizes = np.bincount(owner, minlength=k)
-    b = int(part_sizes.max()) if n else 1
-    b = max(1, -(-b // pad_rows_to) * pad_rows_to)
-    order = np.lexsort((np.arange(n), owner))
-    local_idx = np.empty(n, dtype=np.int64)
-    starts = np.zeros(k + 1, dtype=np.int64)
-    np.cumsum(part_sizes, out=starts[1:])
-    local_idx[order] = np.arange(n) - starts[owner[order]]
+    owner, local_idx, part_sizes, b, row_valid = _relabel(
+        n, partvec, k, pad_rows_to)
     nnz = np.bincount(owner[a.row], minlength=k)
     e = max(1, int(nnz.max()) if len(nnz) else 1)
-    row_valid = np.zeros((k, b), dtype=np.float32)
-    for p in range(k):
-        row_valid[p, : part_sizes[p]] = 1.0
     z = np.zeros
     return CommPlan(
         n=n, k=k, b=b, s=1, r=1, e=e,
@@ -213,22 +226,8 @@ def build_comm_plan(
     """
     a = sp.coo_matrix(a)
     n = a.shape[0]
-    owner = np.asarray(partvec, dtype=np.int64)
-    if owner.shape[0] != n:
-        raise ValueError(f"partvec length {owner.shape[0]} != n {n}")
-    if owner.min() < 0 or owner.max() >= k:
-        raise ValueError("partvec entries out of range")
-
-    part_sizes = np.bincount(owner, minlength=k)
-    b = int(part_sizes.max()) if n else 1
-    b = max(1, -(-b // pad_rows_to) * pad_rows_to)
-
-    # local slot of each vertex: rank by id within its part
-    order = np.lexsort((np.arange(n), owner))          # sorted by (owner, id)
-    local_idx = np.empty(n, dtype=np.int64)
-    starts = np.zeros(k + 1, dtype=np.int64)
-    np.cumsum(part_sizes, out=starts[1:])
-    local_idx[order] = np.arange(n) - starts[owner[order]]
+    owner, local_idx, part_sizes, b, row_valid = _relabel(
+        n, partvec, k, pad_rows_to)
 
     src_g, dst_g, w_g = a.col, a.row, a.data.astype(np.float32)
     eo = owner[dst_g]                                   # chip owning each edge (by row)
@@ -308,10 +307,6 @@ def build_comm_plan(
         edge_dst[p, :cnt] = rows[srt]
         edge_src[p, :cnt] = csrc[srt]
         edge_w[p, :cnt] = vals[srt]
-
-    row_valid = np.zeros((k, b), dtype=np.float32)
-    for p in range(k):
-        row_valid[p, : part_sizes[p]] = 1.0
 
     return CommPlan(
         n=n, k=k, b=b, s=s, r=r, e=e,

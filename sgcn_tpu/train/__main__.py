@@ -62,6 +62,9 @@ def main() -> None:
     if args.backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
+    from ..parallel.launch import init_distributed
+    ctx = init_distributed()   # no-op single-process; SLURM/TPU-pod rendezvous otherwise
+
     import numpy as np
 
     from ..io.mtx import read_mtx
@@ -120,7 +123,8 @@ def main() -> None:
     report["backend"] = args.backend
     report["model"] = args.model
     report.pop("loss_history", None)
-    print(json.dumps(report), flush=True)
+    if ctx.is_coordinator:
+        print(json.dumps(report), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,45 @@
+"""Launcher plumbing tests (single-process paths + SLURM env arithmetic)."""
+
+import os
+
+import numpy as np
+
+from sgcn_tpu.parallel.launch import (
+    global_mesh_1d, init_distributed, slurm_rendezvous_env,
+)
+
+
+def test_init_distributed_single_process():
+    ctx = init_distributed()
+    assert ctx.num_processes == 1
+    assert ctx.process_id == 0
+    assert ctx.is_coordinator
+    assert ctx.global_devices >= 1
+
+
+def test_global_mesh_covers_devices():
+    mesh = global_mesh_1d()
+    import jax
+    assert mesh.devices.size == len(jax.devices())
+    sub = global_mesh_1d(4)
+    assert sub.devices.size == 4
+
+
+def test_slurm_rendezvous_arithmetic(monkeypatch):
+    monkeypatch.setenv("SLURM_NPROCS", "6")
+    monkeypatch.setenv("SLURM_PROCID", "2")
+    monkeypatch.setenv("SLURM_JOBID", "987654321")
+    monkeypatch.setenv("MASTER_ADDR", "node0")
+    monkeypatch.delenv("SGCN_COORDINATOR", raising=False)
+    monkeypatch.delenv("MASTER_PORT", raising=False)
+    coord, nprocs, pid = slurm_rendezvous_env()
+    # port = 10000 + last 4 digits of the job id (reference launcher rule)
+    assert coord == "node0:14321"
+    assert nprocs == 6 and pid == 2
+
+
+def test_slurm_rendezvous_absent(monkeypatch):
+    for var in ("SLURM_NPROCS", "SLURM_PROCID", "MASTER_ADDR",
+                "SGCN_COORDINATOR"):
+        monkeypatch.delenv(var, raising=False)
+    assert slurm_rendezvous_env() is None

@@ -71,3 +71,30 @@ def test_fit_reports_reference_stats(ahat):
     assert len(report["loss_history"]) == 2
     # loss should be decreasing on this easy overfit task
     assert report["loss_history"][-1] < report["loss_history"][0] * 1.5
+
+
+def test_wide_input_project_first_parity(ahat):
+    """Width-aware layer scheduling (project-then-aggregate for wide inputs)
+    must match the oracle's fixed aggregate-first order — same math."""
+    import numpy as np
+    from sgcn_tpu.baselines import DenseOracle
+    from sgcn_tpu.parallel import build_comm_plan
+    from sgcn_tpu.partition import balanced_random_partition
+    from sgcn_tpu.train import FullBatchTrainer, make_train_data
+    from sgcn_tpu.models.gcn import PROJECT_FIRST_MIN_FIN
+
+    n = ahat.shape[0]
+    fin = PROJECT_FIRST_MIN_FIN + 44     # forces the project-first branch
+    rng = np.random.default_rng(11)
+    feats = rng.standard_normal((n, fin)).astype(np.float32)
+    labels = rng.integers(0, 3, n).astype(np.int32)
+    pv = balanced_random_partition(n, 4, seed=6)
+    plan = build_comm_plan(ahat, pv, 4)
+    tr = FullBatchTrainer(plan, fin=fin, widths=[8, 3], seed=3)
+    oracle = DenseOracle(ahat, fin=fin, widths=[8, 3], seed=3)
+    data = make_train_data(plan, feats, labels)
+    np.testing.assert_allclose(tr.predict(data), oracle.predict(feats),
+                               rtol=2e-3, atol=2e-4)
+    dist = [tr.step(data) for _ in range(4)]
+    orac = oracle.fit(feats, labels, epochs=4)
+    np.testing.assert_allclose(dist, orac, rtol=2e-3, atol=2e-4)
