@@ -1,0 +1,105 @@
+"""Built-in datasets and graph generators for tests, fixtures, and benches.
+
+The reference ships tiny fixture graphs for smoke tests (karate at
+``GPU/SHP/data/karate/karate.mtx`` — 34 vertices; gemat11 at
+``GPU/hypergraph/data/gemat11/``) and pulls real benchmark graphs from
+sparse.tamu.edu / OGB as ``.mtx`` (``README.md:11``).  Zero-egress here, so:
+
+  * ``karate()`` — Zachary's karate club (public-domain 1977 sociogram, the
+    same graph as the reference's fixture) built from the edge list, with the
+    standard instructor/administrator faction labels;
+  * ``planted_partition()`` — learnable community graphs for accuracy tests;
+  * ``er_graph()`` — ogbn-scale synthetic graphs for benchmarking (the shape
+    stand-in for ogbn-arxiv/products when the real download is unavailable);
+  * ``save_fixture()`` — emit any of them as ``.mtx`` (+ labels) for CLI
+    round-trip tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+# Zachary karate club, 0-indexed undirected edges (public-domain data).
+_KARATE_EDGES = [
+    (0, 1), (0, 2), (0, 3), (0, 4), (0, 5), (0, 6), (0, 7), (0, 8), (0, 10),
+    (0, 11), (0, 12), (0, 13), (0, 17), (0, 19), (0, 21), (0, 31),
+    (1, 2), (1, 3), (1, 7), (1, 13), (1, 17), (1, 19), (1, 21), (1, 30),
+    (2, 3), (2, 7), (2, 8), (2, 9), (2, 13), (2, 27), (2, 28), (2, 32),
+    (3, 7), (3, 12), (3, 13), (4, 6), (4, 10), (5, 6), (5, 10), (5, 16),
+    (6, 16), (8, 30), (8, 32), (8, 33), (9, 33), (13, 33), (14, 32), (14, 33),
+    (15, 32), (15, 33), (18, 32), (18, 33), (19, 33), (20, 32), (20, 33),
+    (22, 32), (22, 33), (23, 25), (23, 27), (23, 29), (23, 32), (23, 33),
+    (24, 25), (24, 27), (24, 31), (25, 31), (26, 29), (26, 33), (27, 33),
+    (28, 31), (28, 33), (29, 32), (29, 33), (30, 32), (30, 33), (31, 32),
+    (31, 33), (32, 33),
+]
+
+# community membership after the split (0 = instructor's faction).
+_KARATE_LABELS = np.array(
+    [0, 0, 0, 0, 0, 0, 0, 0, 1, 1, 0, 0, 0, 0, 1, 1, 0, 0, 1, 0, 1, 0,
+     1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1], dtype=np.int32)
+
+
+def karate() -> tuple[sp.csr_matrix, np.ndarray]:
+    """(adjacency, labels) — 34 vertices, 78 undirected edges."""
+    e = np.array(_KARATE_EDGES, dtype=np.int64)
+    row = np.concatenate([e[:, 0], e[:, 1]])
+    col = np.concatenate([e[:, 1], e[:, 0]])
+    a = sp.csr_matrix(
+        (np.ones(len(row), np.float32), (row, col)), shape=(34, 34))
+    return a, _KARATE_LABELS.copy()
+
+
+def planted_partition(n: int = 96, nclasses: int = 3, p_in: float = 0.25,
+                      p_out: float = 0.02, noise: float = 0.4,
+                      seed: int = 0):
+    """Community graph + noisy one-hot features a GCN can learn.
+
+    Returns (adjacency, features, labels).
+    """
+    rng = np.random.default_rng(seed)
+    labels = (np.arange(n) % nclasses).astype(np.int32)
+    prob = np.where(labels[:, None] == labels[None, :], p_in, p_out)
+    dense = rng.random((n, n)) < prob
+    dense = np.triu(dense, 1)
+    dense = dense | dense.T
+    a = sp.csr_matrix(dense.astype(np.float32))
+    feats = np.eye(nclasses, dtype=np.float32)[labels]
+    feats = feats + rng.normal(0, noise, (n, nclasses)).astype(np.float32)
+    return a, feats, labels
+
+
+def er_graph(n: int, avg_deg: int = 14, seed: int = 0) -> sp.csr_matrix:
+    """Random symmetric graph with ~n·avg_deg/2 edges (benchmark stand-in
+    for the ogbn-* graphs when offline)."""
+    rng = np.random.default_rng(seed)
+    m = n * avg_deg // 2
+    src = rng.integers(0, n, size=m)
+    dst = rng.integers(0, n, size=m)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    a = sp.coo_matrix((np.ones(len(src), np.float32), (src, dst)), shape=(n, n))
+    return sp.csr_matrix(((a + a.T) > 0).astype(np.float32))
+
+
+def save_fixture(prefix: str, a: sp.spmatrix,
+                 labels: np.ndarray | None = None) -> dict[str, str]:
+    """Write ``<prefix>.A.mtx`` (normalized Â) and optionally ``<prefix>.Y.mtx``
+    (one-hot labels) — the preprocessor's output family
+    (``preprocess/GrB-GNN-IDG.py:80-88``)."""
+    from ..prep import normalize_adjacency
+    from .mtx import write_mtx
+    paths = {}
+    ahat = normalize_adjacency(sp.csr_matrix(a))
+    write_mtx(f"{prefix}.A.mtx", ahat)
+    paths["A"] = f"{prefix}.A.mtx"
+    if labels is not None:
+        n = len(labels)
+        nclasses = int(labels.max()) + 1
+        y = sp.csr_matrix(
+            (np.ones(n, np.float32), (np.arange(n), labels)),
+            shape=(n, nclasses))
+        write_mtx(f"{prefix}.Y.mtx", y)
+        paths["Y"] = f"{prefix}.Y.mtx"
+    return paths

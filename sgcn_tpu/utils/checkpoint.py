@@ -1,0 +1,62 @@
+"""Checkpoint / resume for trainer state.
+
+The reference has NO checkpointing (SURVEY.md §5.4): weights are re-randomized
+every run and only the offline partition artifacts act as a cache.  For long
+TPU runs that is a real gap, so the framework adds a minimal, dependency-free
+checkpoint: all pytree leaves of (params, opt_state) plus a step counter in
+one ``.npz``, restored into the trainer's existing tree structure (which also
+re-applies the mesh sharding via device_put on assignment).
+
+Works for any trainer exposing ``params`` / ``opt_state`` / ``mesh``
+(FullBatchTrainer, MiniBatchTrainer.inner).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..parallel.mesh import replicate
+
+
+def _norm(path: str) -> str:
+    # np.savez appends .npz itself; normalize so save/load accept the same path
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save_checkpoint(trainer, path: str, step: int = 0) -> str:
+    leaves = jax.tree.leaves((trainer.params, trainer.opt_state))
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays["__step__"] = np.asarray(step, dtype=np.int64)
+    path = _norm(path)
+    np.savez(path, **arrays)
+    return path
+
+
+def load_checkpoint(trainer, path: str) -> int:
+    """Restore params/opt_state in place; returns the saved step counter.
+
+    The trainer must have been constructed with the same model config — the
+    leaf count and shapes are validated against its current trees.
+    """
+    with np.load(_norm(path)) as data:
+        step = int(data["__step__"])
+        leaves = [data[f"leaf_{i}"]
+                  for i in range(len(data.files) - 1)]
+    cur = jax.tree.leaves((trainer.params, trainer.opt_state))
+    if len(cur) != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves, trainer expects {len(cur)}")
+    for have, want in zip(leaves, cur):
+        want = np.asarray(want)
+        if tuple(have.shape) != want.shape:
+            raise ValueError(
+                f"checkpoint leaf shape {have.shape} != trainer {want.shape}")
+        if have.dtype != want.dtype:
+            raise ValueError(
+                f"checkpoint leaf dtype {have.dtype} != trainer {want.dtype}")
+    treedef = jax.tree.structure((trainer.params, trainer.opt_state))
+    params, opt_state = jax.tree.unflatten(treedef, leaves)
+    trainer.params = replicate(trainer.mesh, params)
+    trainer.opt_state = replicate(trainer.mesh, opt_state)
+    return step

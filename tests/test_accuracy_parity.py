@@ -1,27 +1,10 @@
 """The reference's correctness criterion: partitioned training must match
 non-partitioned predictive performance (GPU/PGCN-Accuracy.py, README.md:110)."""
 
-import numpy as np
-import pytest
-import scipy.sparse as sp
-
+from sgcn_tpu.io.datasets import planted_partition as planted_graph
 from sgcn_tpu.partition import balanced_random_partition
 from sgcn_tpu.prep import normalize_adjacency
 from sgcn_tpu.train.accuracy import run_accuracy_parity, train_test_split_masks
-
-
-def planted_graph(n=96, nclasses=3, p_in=0.25, p_out=0.02, seed=0):
-    """Community graph whose labels a GCN can actually learn."""
-    rng = np.random.default_rng(seed)
-    labels = (np.arange(n) % nclasses).astype(np.int32)
-    prob = np.where(labels[:, None] == labels[None, :], p_in, p_out)
-    dense = rng.random((n, n)) < prob
-    dense = np.triu(dense, 1)
-    dense = dense | dense.T
-    a = sp.csr_matrix(dense.astype(np.float32))
-    feats = np.eye(nclasses, dtype=np.float32)[labels]
-    feats = feats + rng.normal(0, 0.4, (n, nclasses)).astype(np.float32)
-    return a, feats, labels
 
 
 def test_split_masks_disjoint():

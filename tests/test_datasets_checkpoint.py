@@ -1,0 +1,88 @@
+"""Datasets + checkpoint/resume tests."""
+
+import numpy as np
+
+from sgcn_tpu.io.datasets import er_graph, karate, planted_partition, save_fixture
+from sgcn_tpu.parallel import build_comm_plan
+from sgcn_tpu.partition import balanced_random_partition
+from sgcn_tpu.prep import normalize_adjacency
+from sgcn_tpu.train import FullBatchTrainer, make_train_data
+from sgcn_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+def test_karate_structure():
+    a, labels = karate()
+    assert a.shape == (34, 34)
+    assert a.nnz == 156                      # 78 undirected edges
+    assert (a != a.T).nnz == 0               # symmetric
+    assert a.diagonal().sum() == 0           # no self loops
+    assert labels.shape == (34,)
+    assert set(np.unique(labels)) == {0, 1}
+
+
+def test_planted_partition_learnable():
+    a, feats, labels = planted_partition(n=60, nclasses=3, seed=1)
+    assert a.shape == (60, 60)
+    assert feats.shape == (60, 3)
+    assert (a != a.T).nnz == 0
+
+
+def test_er_graph():
+    a = er_graph(500, avg_deg=10, seed=0)
+    assert a.shape == (500, 500)
+    assert (a != a.T).nnz == 0
+    deg = np.asarray(a.sum(axis=1)).ravel()
+    assert 5 < deg.mean() < 15
+
+
+def test_save_fixture_roundtrip(tmp_path):
+    from sgcn_tpu.io.mtx import read_mtx
+    a, labels = karate()
+    paths = save_fixture(str(tmp_path / "karate"), a, labels)
+    ahat = read_mtx(paths["A"])
+    assert ahat.shape == (34, 34)
+    y = read_mtx(paths["Y"])
+    assert y.shape == (34, 2)
+    np.testing.assert_array_equal(
+        np.asarray(y.todense()).argmax(1), labels)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    a, labels = karate()
+    ahat = normalize_adjacency(a)
+    n = 34
+    feats = np.eye(2, dtype=np.float32)[labels]
+    pv = balanced_random_partition(n, 2, seed=0)
+    plan = build_comm_plan(ahat, pv, 2)
+    data = make_train_data(plan, feats, labels)
+
+    tr = FullBatchTrainer(plan, fin=2, widths=[8, 2], seed=1)
+    for _ in range(3):
+        tr.step(data)
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(tr, path, step=3)
+    expected = tr.predict(data)
+
+    tr2 = FullBatchTrainer(plan, fin=2, widths=[8, 2], seed=99)
+    assert load_checkpoint(tr2, path) == 3
+    np.testing.assert_allclose(tr2.predict(data), expected, rtol=1e-6)
+    # resumed training continues identically to uninterrupted training
+    l1 = tr.step(data)
+    l2 = tr2.step(data)
+    assert abs(l1 - l2) < 1e-6
+
+
+def test_checkpoint_shape_mismatch_rejected(tmp_path):
+    a, labels = karate()
+    ahat = normalize_adjacency(a)
+    pv = balanced_random_partition(34, 2, seed=0)
+    plan = build_comm_plan(ahat, pv, 2)
+    tr = FullBatchTrainer(plan, fin=2, widths=[8, 2], seed=1)
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(tr, path)
+    other = FullBatchTrainer(plan, fin=2, widths=[16, 2], seed=1)
+    try:
+        load_checkpoint(other, path)
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
