@@ -24,6 +24,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <numeric>
 #include <random>
@@ -602,6 +603,119 @@ int sgcn_partition_hypergraph(i32 ncells, i32 nnets, const i64* cellptr,
   return 0;
 }
 
+// Buffer-scanning MatrixMarket coordinate reader used by the native CLI
+// (role of the reference's C readers, Parallel-GCN/main.c:609-648,
+// GCN-HP/main.cpp:366-405).  NOTE the Python path (sgcn_tpu/io/mtx.py) uses
+// scipy's multithreaded fast_matrix_market parser, which measured faster
+// than this single-threaded scanner — this exists so `sgcnpart` has no
+// Python dependency, not as the Python loader.
+// Line-aware: comments allowed anywhere, extra per-line tokens (e.g. the
+// imaginary part of complex files) ignored.  Symmetric/skew storage
+// expanded, pattern values = 1.0.  Outputs malloc'd arrays owned by the
+// caller (release with sgcn_free).  Returns 0 ok, 1 io error, 2 malformed,
+// 3 out of memory.
+int sgcn_read_mtx(const char* path, i64* nrows_out, i64* ncols_out,
+                  i64* nnz_out, i32** row_out, i32** col_out,
+                  float** val_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return 1;
+  if (std::fseek(f, 0, SEEK_END) != 0) { std::fclose(f); return 1; }
+  long fsize = std::ftell(f);
+  if (fsize < 0 || std::fseek(f, 0, SEEK_SET) != 0) {
+    std::fclose(f);
+    return 1;
+  }
+  std::vector<char> buf((size_t)fsize + 1);
+  if (fsize > 0 && std::fread(buf.data(), 1, fsize, f) != (size_t)fsize) {
+    std::fclose(f);
+    return 1;
+  }
+  std::fclose(f);
+  buf[fsize] = '\0';
+
+  const char* p = buf.data();
+  const char* end = p + fsize;
+  bool symmetric = false, skew = false, pattern = false;
+  bool header_done = false;
+  long long nr = 0, nc = 0, declared = 0;
+  size_t cap = 0, nnz = 0;
+  i32* rows = nullptr;
+  i32* cols = nullptr;
+  float* vals = nullptr;
+  auto fail = [&](int rc) {
+    std::free(rows); std::free(cols); std::free(vals);
+    return rc;
+  };
+
+  while (p < end) {
+    // start of line: skip blank lines, handle comments anywhere
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    const char* nl = (const char*)memchr(p, '\n', end - p);
+    const char* lend = nl ? nl : end;
+    if (*p == '%') {
+      if (!header_done && (size_t)(lend - p) > 14 &&
+          std::strncmp(p, "%%MatrixMarket", 14) == 0) {
+        std::string line(p, lend);
+        symmetric = line.find("symmetric") != std::string::npos;
+        skew = line.find("skew-symmetric") != std::string::npos;
+        pattern = line.find("pattern") != std::string::npos;
+      }
+      p = lend;
+      continue;
+    }
+    char* q;
+    if (!header_done) {
+      nr = strtoll(p, &q, 10);
+      if (q == p) return fail(2);
+      p = q;
+      nc = strtoll(p, &q, 10);
+      if (q == p) return fail(2);
+      p = q;
+      declared = strtoll(p, &q, 10);
+      if (q == p) return fail(2);
+      if (nr <= 0 || nc <= 0 || declared < 0) return fail(2);
+      cap = (symmetric || skew) ? 2 * (size_t)declared : (size_t)declared;
+      if (cap == 0) cap = 1;               // malloc(0) may return NULL
+      rows = (i32*)std::malloc(cap * sizeof(i32));
+      cols = (i32*)std::malloc(cap * sizeof(i32));
+      vals = (float*)std::malloc(cap * sizeof(float));
+      if (!rows || !cols || !vals) return fail(3);
+      header_done = true;
+      p = lend;
+      continue;
+    }
+    long long i = strtoll(p, &q, 10);
+    if (q == p) return fail(2);
+    p = q;
+    long long j = strtoll(p, &q, 10);
+    if (q == p) return fail(2);
+    p = q;
+    double v = 1.0;
+    if (!pattern) {
+      v = strtod(p, &q);
+      if (q == p) return fail(2);
+    }
+    --i; --j;
+    if (i < 0 || j < 0 || i >= nr || j >= nc || nnz >= cap) return fail(2);
+    rows[nnz] = (i32)i; cols[nnz] = (i32)j; vals[nnz] = (float)v;
+    ++nnz;
+    if ((symmetric || skew) && i != j) {
+      if (nnz >= cap) return fail(2);
+      rows[nnz] = (i32)j; cols[nnz] = (i32)i;
+      vals[nnz] = skew ? -(float)v : (float)v;
+      ++nnz;
+    }
+    p = lend;                              // extra tokens (complex) ignored
+  }
+  if (!header_done) return fail(2);
+  *nrows_out = nr; *ncols_out = nc; *nnz_out = (i64)nnz;
+  *row_out = rows; *col_out = cols; *val_out = vals;
+  return 0;
+}
+
+void sgcn_free(void* ptr) { std::free(ptr); }
+
 }  // extern "C"
 
 // ===================================================================== CLI
@@ -618,54 +732,24 @@ namespace {
 struct Coo { i32 n = 0; std::vector<i32> row, col; std::vector<float> val; };
 
 bool read_mtx(const std::string& path, Coo& out) {
-  std::ifstream f(path);
-  if (!f) return false;
-  std::string line;
-  bool symmetric = false, pattern = false, header_done = false;
-  i64 declared_nnz = 0;
-  while (std::getline(f, line)) {
-    if (line.empty()) continue;
-    if (line[0] == '%') {
-      if (line.rfind("%%MatrixMarket", 0) == 0) {
-        symmetric = line.find("symmetric") != std::string::npos;
-        pattern = line.find("pattern") != std::string::npos;
-      }
-      continue;
-    }
-    std::istringstream iss(line);
-    if (!header_done) {
-      i64 r, c, z;
-      if (!(iss >> r >> c >> z)) return false;
-      out.n = (i32)std::max(r, c);
-      declared_nnz = z;
-      out.row.reserve(symmetric ? 2 * z : z);
-      header_done = true;
-      continue;
-    }
-    i64 i, j; double v = 1.0;
-    if (!(iss >> i >> j)) {
-      // tolerate whitespace-only tails; reject anything else
-      std::string tok;
-      std::istringstream chk(line);
-      if (chk >> tok) { std::fprintf(stderr, "bad mtx line: %s\n", line.c_str()); return false; }
-      continue;
-    }
-    if (!pattern && !(iss >> v)) { std::fprintf(stderr, "bad mtx line: %s\n", line.c_str()); return false; }
-    --i; --j;
-    if (i < 0 || j < 0 || i >= out.n || j >= out.n) {
-      std::fprintf(stderr, "mtx index out of range: %lld %lld\n",
-                   (long long)(i + 1), (long long)(j + 1));
-      return false;
-    }
-    out.row.push_back((i32)i); out.col.push_back((i32)j);
-    out.val.push_back((float)v);
-    if (symmetric && i != j) {
-      out.row.push_back((i32)j); out.col.push_back((i32)i);
-      out.val.push_back((float)v);
-    }
+  // thin wrapper over the shared buffer-scanning parser (sgcn_read_mtx)
+  i64 nr = 0, nc = 0, nnz = 0;
+  i32 *rows = nullptr, *cols = nullptr;
+  float* vals = nullptr;
+  int rc = sgcn_read_mtx(path.c_str(), &nr, &nc, &nnz, &rows, &cols, &vals);
+  if (rc != 0) {
+    const char* why = rc == 1 ? "cannot open"
+                    : rc == 3 ? "out of memory reading"
+                    : "malformed mtx";
+    std::fprintf(stderr, "%s %s\n", why, path.c_str());
+    return false;
   }
-  (void)declared_nnz;
-  return header_done;
+  out.n = (i32)std::max(nr, nc);
+  out.row.assign(rows, rows + nnz);
+  out.col.assign(cols, cols + nnz);
+  out.val.assign(vals, vals + nnz);
+  sgcn_free(rows); sgcn_free(cols); sgcn_free(vals);
+  return true;
 }
 
 }  // namespace

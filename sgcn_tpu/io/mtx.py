@@ -21,6 +21,12 @@ def read_mtx(path: str) -> sp.csr_matrix:
     Symmetric / skew / pattern storage is expanded (mirrors the reference's
     readers, which honor the symmetric qualifier — ``GCN-HP/main.cpp:366-405``).
     Pattern files get all-ones values.
+
+    scipy ≥1.12's mmread is the multithreaded fast_matrix_market C++ parser —
+    measured FASTER than a hand-rolled single-threaded native reader here, so
+    it IS the native-loader path (the role of the reference's C readers,
+    ``Parallel-GCN/main.c:609-648``).  The C++ CLI has its own buffer-scanning
+    parser (``native/sgcnpart.cpp`` ``sgcn_read_mtx``) for fully-native runs.
     """
     m = scipy.io.mmread(path)
     m = sp.csr_matrix(m, dtype=np.float32)

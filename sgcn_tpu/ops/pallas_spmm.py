@@ -1,0 +1,116 @@
+"""Pallas TPU SpMM kernel (dst-tiled) — the hand-written alternative to the
+XLA gather/segment-sum path in ``sgcn_tpu.ops.pspmm``.
+
+Status and honest measurements (v5e, 2026-07, see also PARITY.md): the graph
+SpMM is the framework's hot op (27 ms vs 5.6 ms dense at ogbn-arxiv scale,
+f=128) and is bound by random HBM row access in the gather.  Measured
+head-to-head:
+
+  * flat ``take`` + sorted ``segment_sum`` (the shipped default) — 27 ms at
+    n=169k; dst-tiled vmap/scan reformulations of the same math in pure XLA
+    are slower (33 / 44 ms);
+  * this Pallas kernel holds the whole feature table VMEM-resident and
+    accumulates per edge from SMEM-prefetched indices.  Where the table fits
+    VMEM (≈ a few MB, n≈2k at f=128 on v5e) it measured ~1.3× faster than
+    the XLA path (14.1 vs 18.6 ms, interleaved, congested chip); beyond VMEM
+    the Mosaic compile fails, so `spmm_pallas` is opt-in, not the default.
+
+So per SURVEY.md §7.1 ("Pallas kernel only if BCOO SpMM is the bottleneck"):
+it IS the bottleneck, but at full scale the limiting resource is HBM random
+access, which XLA's gather already saturates.  The kernel is kept as a
+first-class, tested op (interpret-mode CI + TPU parity): the starting point
+for per-chip blocks small enough to pin in VMEM — which is exactly what
+k-way partitioning produces as k grows — and for future HBM-table variants.
+
+Layout: edges are grouped into tiles of ``TB`` consecutive dst rows (plan
+edge lists are dst-sorted already), each tile padded to ``Emax`` edges;
+``build_dst_tiles`` converts any (edge_dst, edge_src, edge_w) triple.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_dst_tiles(edge_dst, edge_src, edge_w, num_rows: int, tb: int = 256):
+    """Group dst-sorted edges into ceil(num_rows/tb) row tiles.
+
+    Returns ``(tsrc, tld, tw, padded_rows)`` — the first three in the exact
+    positional order ``spmm_pallas`` consumes, each (T, Emax); pad edges
+    carry weight 0 and local dst tb-1.
+    """
+    edge_dst = np.asarray(edge_dst)
+    edge_src = np.asarray(edge_src)
+    edge_w = np.asarray(edge_w)
+    t = -(-num_rows // tb)
+    tile_of_edge = edge_dst // tb
+    counts = np.bincount(tile_of_edge, minlength=t)
+    emax = max(8, int(counts.max()))
+    emax = -(-emax // 8) * 8
+    tsrc = np.zeros((t, emax), np.int32)
+    tw = np.zeros((t, emax), np.float32)
+    tld = np.full((t, emax), tb - 1, np.int32)
+    # edges are dst-sorted, so per-tile runs are contiguous
+    starts = np.zeros(t + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    for i in range(t):
+        s, e = starts[i], starts[i + 1]
+        c = e - s
+        tsrc[i, :c] = edge_src[s:e]
+        tw[i, :c] = edge_w[s:e]
+        tld[i, :c] = edge_dst[s:e] - i * tb
+    return tsrc, tld, tw, t * tb
+
+
+@partial(jax.jit, static_argnames=("tb", "interpret"))
+def spmm_pallas(tsrc, tld, tw, table, tb: int = 256, interpret: bool = False):
+    """Â·table via the tiled Pallas kernel.
+
+    Args:
+      tsrc/tld/tw: (T, Emax) tile arrays from ``build_dst_tiles``.
+      table: (N, f) feature rows (local ‖ halo), f a multiple of 128 ideally.
+      interpret: run in interpreter mode (CPU CI).
+
+    Returns (T·tb, f); slice to the true row count.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t, emax = tsrc.shape
+    f = table.shape[-1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,     # tsrc, tld, tw land in SMEM (scalar reads)
+        grid=(t,),
+        in_specs=[
+            # whole feature table resident in VMEM — the kernel's premise
+            # (and its size limit; see module docstring)
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tb, f), lambda i, *pf: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((tb, f), jnp.float32)],
+    )
+
+    def kernel(tsrc_pf, tld_pf, tw_pf, table_ref, out_ref, acc_ref):
+        i = pl.program_id(0)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        def body(e, _):
+            src = tsrc_pf[i, e]
+            ld = tld_pf[i, e]
+            w = tw_pf[i, e]
+            acc_ref[pl.ds(ld, 1), :] += w * table_ref[pl.ds(src, 1), :]
+            return 0
+
+        jax.lax.fori_loop(0, tsrc_pf.shape[1], body, 0)
+        out_ref[:] = acc_ref[:]
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t * tb, f), jnp.float32),
+        interpret=interpret,
+    )(tsrc, tld, tw, table)
