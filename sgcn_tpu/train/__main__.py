@@ -39,6 +39,8 @@ def main() -> None:
     p.add_argument("-n", "--batch-size", type=int, default=None,
                    help="enable the mini-batch trainer")
     p.add_argument("--model", default="gcn", choices=["gcn", "gat"])
+    p.add_argument("--dtype", default=None, choices=["bfloat16"],
+                   help="mixed-precision compute (f32 master params)")
     p.add_argument("--epochs", type=int, default=4)
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--lr", type=float, default=0.01)
@@ -109,13 +111,15 @@ def main() -> None:
     if args.batch_size is not None:
         tr = MiniBatchTrainer(a, pv, k, fin=f, widths=widths,
                               batch_size=args.batch_size, lr=args.lr,
-                              model=args.model, seed=args.seed)
+                              model=args.model, seed=args.seed,
+                              compute_dtype=args.dtype)
         report = tr.fit(feats, labels, epochs=args.epochs,
                         warmup=args.warmup)
     else:
         plan = build_comm_plan(a, pv, k)
         tr = FullBatchTrainer(plan, fin=f, widths=widths, lr=args.lr,
-                              model=args.model, seed=args.seed)
+                              model=args.model, seed=args.seed,
+                              compute_dtype=args.dtype)
         data = make_train_data(plan, feats, labels)
         report = tr.fit(data, epochs=args.epochs, warmup=args.warmup)
 

@@ -114,11 +114,17 @@ class FullBatchTrainer:
         optimizer: optax.GradientTransformation | None = None,
         seed: int = 0,
         model: str = "gcn",
+        compute_dtype: str | None = None,
     ):
+        """``compute_dtype='bfloat16'`` runs forward/backward (including the
+        halo exchange — half the ICI bytes) in bf16 with f32 master params
+        and f32 loss/grad reduction; the reference stacks are f32-only, this
+        is the TPU-native mixed-precision option (MXU eats bf16)."""
         self.plan = plan
         self.mesh = mesh if mesh is not None else make_mesh_1d(plan.k)
         self.activation = activation
         self.final_activation = final_activation
+        self.compute_dtype = compute_dtype
         init_fn, self._forward_fn = MODELS[model]
         self.model = model
         dims = list(zip([fin] + widths[:-1], widths))
@@ -134,13 +140,20 @@ class FullBatchTrainer:
 
     # ------------------------------------------------------------------ build
     def _forward(self, params, pa, h0):
-        return self._forward_fn(
+        if self.compute_dtype is not None:
+            import jax.numpy as jnp
+            dt = jnp.dtype(self.compute_dtype)
+            params = jax.tree.map(lambda w: w.astype(dt), params)
+            h0 = h0.astype(dt)
+            pa = {**pa, "edge_w": pa["edge_w"].astype(dt)}
+        out = self._forward_fn(
             params, h0,
             pa["send_idx"], pa["halo_src"],
             pa["edge_dst"], pa["edge_src"], pa["edge_w"],
             activation=self.activation,
             final_activation=self.final_activation,
         )
+        return out.astype("float32")
 
     def _build_step(self):
         def per_chip(params, opt_state, pa, h0, labels, valid):

@@ -79,6 +79,7 @@ class MiniBatchTrainer:
         optimizer: optax.GradientTransformation | None = None,
         seed: int = 0,
         pad_rows_to: int = 8,
+        compute_dtype: str | None = None,
     ):
         self.a = sp.csr_matrix(a)
         n = self.a.shape[0]
@@ -101,7 +102,8 @@ class MiniBatchTrainer:
         # one inner trainer = one compiled step for every batch
         self.inner = FullBatchTrainer(
             self.plans[0], fin, widths, mesh=self.mesh, lr=lr,
-            activation=activation, model=model, optimizer=optimizer, seed=seed)
+            activation=activation, model=model, optimizer=optimizer, seed=seed,
+            compute_dtype=compute_dtype)
         self.total_exchanged_rows = 0
         self.nlayers = len(widths)
         self._fullgraph_eval = None   # built lazily, cached across calls

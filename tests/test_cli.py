@@ -1,0 +1,96 @@
+"""End-to-end CLI integration: preprocess → partition → SHP → train.
+
+Exercises the same file-pipeline layering as the reference (SURVEY.md §1):
+stages communicate only through files on disk.  Subprocesses run on forced
+CPU with k virtual devices (the trainer CLI's ``-b cpu`` backend does this
+itself); module CLIs are invoked via ``python -m``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(args, **kw):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)          # let -b cpu set its own device count
+    env["PYTHONPATH"] = REPO
+    return subprocess.run([sys.executable, "-m", *args], capture_output=True,
+                          text=True, cwd=REPO, env=env, timeout=600, **kw)
+
+
+@pytest.fixture(scope="module")
+def pipeline(tmp_path_factory):
+    """prep + partition once for all CLI tests."""
+    d = tmp_path_factory.mktemp("cli")
+    from sgcn_tpu.io.datasets import er_graph
+    from sgcn_tpu.io.mtx import write_mtx
+    write_mtx(str(d / "g.mtx"), er_graph(150, 8, seed=3))
+
+    r = run_cli(["sgcn_tpu.prep", "-a", str(d / "g.mtx"), "-o", str(d),
+                 "-n", "g", "-l", "2", "-f", "8", "-c", "3"])
+    assert r.returncode == 0, r.stderr
+    r = run_cli(["sgcn_tpu.partition", "-a", str(d / "g.A.mtx"), "-k", "4",
+                 "-m", "hp,rp"])
+    assert r.returncode == 0, r.stderr
+    return d
+
+
+def test_prep_outputs(pipeline):
+    d = pipeline
+    for f in ("g.A.mtx", "g.H.mtx", "g.Y.mtx", "config"):
+        assert (d / f).exists(), f
+    toks = (d / "config").read_text().split()
+    assert toks[0] == "2" and toks[1] == "150"
+
+
+def test_partition_outputs(pipeline):
+    d = pipeline
+    from sgcn_tpu.partition import read_partvec
+    for suf in ("hp", "rp"):
+        pv = read_partvec(str(d / f"g.A.mtx.4.{suf}"))
+        assert pv.shape == (150,)
+        assert pv.max() < 4
+
+
+def test_train_cli_fullbatch(pipeline):
+    d = pipeline
+    r = run_cli(["sgcn_tpu.train", "-a", str(d / "g.A.mtx"),
+                 "-p", str(d / "g.A.mtx.4.hp"), "-b", "cpu", "-s", "4",
+                 "-l", "2", "-f", "6", "--epochs", "2"])
+    assert r.returncode == 0, r.stderr
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["epochs"] == 2
+    assert report["total_send_volume"] > 0
+
+
+def test_shp_to_minibatch_train(pipeline):
+    """SHP pickles feed the mini-batch trainer (the reference's coupling:
+    GPU/SHP/main.py:131-140 → PGCN-Mini-batch.py:217-218)."""
+    d = pipeline
+    r = run_cli(["sgcn_tpu.shp", "-p", str(d / "g.A.mtx"), "-k", "3",
+                 "-s", "4", "-b", "30", "-m", "3", "-o", str(d)])
+    assert r.returncode == 0, r.stderr
+    assert (d / "partvec.stchp.3").exists()
+    r = run_cli(["sgcn_tpu.train", "-a", str(d / "g.A.mtx"),
+                 "-p", str(d / "partvec.stchp.3"), "-b", "cpu", "-s", "3",
+                 "-l", "2", "-f", "6", "-n", "40", "--epochs", "1"])
+    assert r.returncode == 0, r.stderr
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["nbatches"] > 0
+
+
+def test_train_cli_rejects_bad_partvec(pipeline):
+    d = pipeline
+    (d / "bad.part").write_text("0 1 2\n")
+    r = run_cli(["sgcn_tpu.train", "-a", str(d / "g.A.mtx"),
+                 "-p", str(d / "bad.part"), "-b", "cpu", "-s", "4",
+                 "-l", "2", "-f", "4"])
+    assert r.returncode != 0
+    assert "partvec length" in r.stderr

@@ -98,3 +98,26 @@ def test_wide_input_project_first_parity(ahat):
     dist = [tr.step(data) for _ in range(4)]
     orac = oracle.fit(feats, labels, epochs=4)
     np.testing.assert_allclose(dist, orac, rtol=2e-3, atol=2e-4)
+
+
+def test_bf16_compute_tracks_f32(ahat):
+    """Mixed-precision option: same trajectory within bf16 tolerance."""
+    import numpy as np
+    from sgcn_tpu.parallel import build_comm_plan
+    from sgcn_tpu.partition import balanced_random_partition
+    from sgcn_tpu.train import FullBatchTrainer, make_train_data
+
+    n = ahat.shape[0]
+    rng = np.random.default_rng(4)
+    feats = rng.standard_normal((n, 12)).astype(np.float32)
+    labels = rng.integers(0, 3, n).astype(np.int32)
+    pv = balanced_random_partition(n, 4, seed=1)
+    plan = build_comm_plan(ahat, pv, 4)
+    data = make_train_data(plan, feats, labels)
+    f32 = FullBatchTrainer(plan, fin=12, widths=[8, 3], seed=2)
+    b16 = FullBatchTrainer(plan, fin=12, widths=[8, 3], seed=2,
+                           compute_dtype="bfloat16")
+    l32 = [f32.step(data) for _ in range(5)]
+    l16 = [b16.step(data) for _ in range(5)]
+    np.testing.assert_allclose(l16, l32, rtol=0.05, atol=0.02)
+    assert l16[-1] < l16[0]
