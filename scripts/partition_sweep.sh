@@ -1,0 +1,24 @@
+#!/bin/bash
+# Sweep partitioners over k for every dataset directory — role of the
+# reference's GPU/graph/run.sh and GPU/hypergraph/run.sh batch drivers
+# (k ∈ {2,3,9,15,21,27} over each dataset dir).
+#
+# Usage: scripts/partition_sweep.sh DATA_DIR [modes] [k1 k2 ...]
+#   DATA_DIR contains one subdirectory per dataset with <name>.A.mtx inside.
+set -euo pipefail
+
+DATA_DIR=${1:?usage: partition_sweep.sh DATA_DIR [modes] [k...]}
+MODES=${2:-hp,gp,rp}
+shift $(( $# > 2 ? 2 : $# ))
+KS=("${@:-2 3 9 15 21 27}")
+[ ${#KS[@]} -eq 1 ] && KS=(${KS[0]})
+
+for d in "$DATA_DIR"/*/; do
+  name=$(basename "$d")
+  a="$d/$name.A.mtx"
+  [ -f "$a" ] || continue
+  for k in "${KS[@]}"; do
+    echo "== $name k=$k modes=$MODES"
+    python -m sgcn_tpu.partition -a "$a" -k "$k" -m "$MODES"
+  done
+done

@@ -115,16 +115,24 @@ class FullBatchTrainer:
         seed: int = 0,
         model: str = "gcn",
         compute_dtype: str | None = None,
+        remat: bool = False,
     ):
         """``compute_dtype='bfloat16'`` runs forward/backward (including the
         halo exchange — half the ICI bytes) in bf16 with f32 master params
         and f32 loss/grad reduction; the reference stacks are f32-only, this
-        is the TPU-native mixed-precision option (MXU eats bf16)."""
+        is the TPU-native mixed-precision option (MXU eats bf16).
+
+        ``remat=True`` wraps the forward in ``jax.checkpoint`` so layer
+        activations are recomputed in the backward pass instead of stored —
+        the HBM-for-FLOPs trade for deep stacks / huge vertex counts (no
+        reference analogue; the MPI code stores every layer's H and Z,
+        ``Parallel-GCN/main.c:553-607``)."""
         self.plan = plan
         self.mesh = mesh if mesh is not None else make_mesh_1d(plan.k)
         self.activation = activation
         self.final_activation = final_activation
         self.compute_dtype = compute_dtype
+        self.remat = remat
         init_fn, self._forward_fn = MODELS[model]
         self.model = model
         dims = list(zip([fin] + widths[:-1], widths))
@@ -159,8 +167,11 @@ class FullBatchTrainer:
         def per_chip(params, opt_state, pa, h0, labels, valid):
             pa, h0, labels, valid = _unblock((pa, h0, labels, valid))
 
+            fwd = (jax.checkpoint(self._forward, static_argnums=())
+                   if self.remat else self._forward)
+
             def loss_fn(ps):
-                logits = self._forward(ps, pa, h0)
+                logits = fwd(ps, pa, h0)
                 return masked_softmax_xent_local(logits, labels, valid)
 
             loss, grads = jax.value_and_grad(loss_fn)(params)

@@ -173,7 +173,8 @@ class MiniBatchTrainer:
             self._fullgraph_eval = (plan, FullBatchTrainer(
                 plan, features.shape[1], self._widths_from_params(),
                 mesh=self.mesh, activation=self.inner.activation,
-                model=self.inner.model))
+                model=self.inner.model,
+                compute_dtype=self.inner.compute_dtype))
         plan, tr = self._fullgraph_eval
         tr.params = self.inner.params
         data = make_train_data(plan, features, labels,

@@ -131,3 +131,28 @@ def test_rank_files_consistent_with_plan(tmp_path, ahat):
             assert int(hdr[0]) == n
             rows = {int(line.split()[0]) for line in f}
         assert rows.issubset(set(np.where(pv == r)[0]))
+
+
+def test_partitioners_beat_random_on_community_graph():
+    """On a community-structured graph the multilevel partitioners must cut
+    far less than random — the quality margin SURVEY.md §7.3 requires."""
+    from sgcn_tpu.io.datasets import planted_partition
+    from sgcn_tpu.parallel import build_comm_plan
+    from sgcn_tpu.prep import normalize_adjacency
+
+    a, _, _ = planted_partition(n=240, nclasses=4, p_in=0.3, p_out=0.01,
+                                seed=5)
+    ahat = normalize_adjacency(a)
+    k = 4
+    vols = {}
+    for name, pv in (
+        ("hp", partition_hypergraph_colnet(ahat, k, seed=1)[0]),
+        ("gp", partition_graph(ahat, k, seed=1)[0]),
+        ("rp", balanced_random_partition(240, k, seed=1)),
+    ):
+        vols[name] = int(build_comm_plan(ahat, pv, k)
+                         .predicted_send_volume.sum())
+    # random sends nearly everything; the real partitioners should find the
+    # planted communities and cut at most half of random's volume
+    assert vols["hp"] < 0.5 * vols["rp"], vols
+    assert vols["gp"] < 0.5 * vols["rp"], vols

@@ -121,3 +121,24 @@ def test_bf16_compute_tracks_f32(ahat):
     l16 = [b16.step(data) for _ in range(5)]
     np.testing.assert_allclose(l16, l32, rtol=0.05, atol=0.02)
     assert l16[-1] < l16[0]
+
+
+def test_remat_matches_plain(ahat):
+    """jax.checkpoint rematerialization must not change the math."""
+    import numpy as np
+    from sgcn_tpu.parallel import build_comm_plan
+    from sgcn_tpu.partition import balanced_random_partition
+    from sgcn_tpu.train import FullBatchTrainer, make_train_data
+
+    n = ahat.shape[0]
+    rng = np.random.default_rng(8)
+    feats = rng.standard_normal((n, 10)).astype(np.float32)
+    labels = rng.integers(0, 3, n).astype(np.int32)
+    pv = balanced_random_partition(n, 4, seed=2)
+    plan = build_comm_plan(ahat, pv, 4)
+    data = make_train_data(plan, feats, labels)
+    plain = FullBatchTrainer(plan, fin=10, widths=[8, 8, 3], seed=4)
+    rem = FullBatchTrainer(plan, fin=10, widths=[8, 8, 3], seed=4, remat=True)
+    lp = [plain.step(data) for _ in range(4)]
+    lr = [rem.step(data) for _ in range(4)]
+    np.testing.assert_allclose(lr, lp, rtol=1e-5, atol=1e-6)
