@@ -1,0 +1,28 @@
+"""Driver entry-point gates.
+
+Round 1's driver check failed because ``dryrun_multichip(8)`` demanded an
+8-device mesh from a backend already initialized on one real TPU chip
+(MULTICHIP_r01.json rc=1).  These tests pin the fix: the entry point must
+self-provision a virtual CPU mesh, in-process when the backend already has
+enough devices and via subprocess re-exec when it does not.
+"""
+
+import __graft_entry__ as graft
+
+
+def test_dryrun_multichip_in_process():
+    # conftest provides 8 virtual CPU devices, so this takes the direct path
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_subprocess_self_provisions():
+    # asking for more devices than the live backend has forces the driver
+    # fallback: re-exec in a subprocess with the virtual-mesh env vars
+    graft.dryrun_multichip(16)
+
+
+def test_entry_forward_compiles():
+    import jax
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (64, 4)
