@@ -67,8 +67,11 @@ def slurm_rendezvous_env() -> tuple[str, int, int] | None:
         return None
     port = os.environ.get("MASTER_PORT")
     if port is None:
-        jobid = os.environ.get("SLURM_JOBID", "0")
-        port = str(10000 + int(jobid[-4:] or 0))
+        # array/het job ids like "1234_5" contain non-digits; keep the
+        # digits so the port stays derivable instead of crashing startup
+        jobid = "".join(c for c in os.environ.get("SLURM_JOBID", "0")
+                        if c.isdigit())
+        port = str(10000 + int(jobid[-4:] or "0"))
     return f"{addr}:{port}", int(nprocs), int(procid)
 
 
