@@ -22,13 +22,15 @@
 // with METIS/PaToH is a non-goal.
 
 #include <algorithm>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <numeric>
-#include <random>
+#include <queue>
 #include <string>
+#include <tuple>
 #include <unordered_map>
 #include <vector>
 
@@ -36,6 +38,30 @@ namespace {
 
 using i32 = int32_t;
 using i64 = int64_t;
+
+// Portable deterministic RNG (splitmix64).  std::shuffle /
+// std::uniform_int_distribution are implementation-defined mappings, so
+// seeded partitions would differ across standard libraries; every draw here
+// is pinned to this generator instead.
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    s += 0x9E3779B97F4A7C15ULL;
+    uint64_t z = s;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  }
+  // uniform in [0, n); modulo bias is irrelevant at these magnitudes
+  uint64_t below(uint64_t n) { return n ? next() % n : 0; }
+};
+
+template <typename T>
+void fy_shuffle(std::vector<T>& v, Rng& rng) {
+  for (size_t i = v.size(); i > 1; --i)
+    std::swap(v[i - 1], v[rng.below(i)]);
+}
 
 struct Graph {
   i32 n = 0;
@@ -52,10 +78,10 @@ struct MatchResult {
   i32 cn = 0;
 };
 
-MatchResult heavy_edge_matching(const Graph& g, std::mt19937& rng) {
+MatchResult heavy_edge_matching(const Graph& g, Rng& rng) {
   std::vector<i32> order(g.n);
   std::iota(order.begin(), order.end(), 0);
-  std::shuffle(order.begin(), order.end(), rng);
+  fy_shuffle(order, rng);
   std::vector<i32> match(g.n, -1);
   for (i32 v : order) {
     if (match[v] != -1) continue;
@@ -119,13 +145,13 @@ Graph contract(const Graph& g, const MatchResult& m) {
 // Greedy k-way growing: spread seeds, grow parts by absorbing the frontier
 // vertex with the strongest connection to the part, under the balance cap.
 void greedy_grow(const Graph& g, int k, double cap, std::vector<i32>& part,
-                 std::mt19937& rng) {
+                 Rng& rng) {
   part.assign(g.n, -1);
   std::vector<i64> pw(k, 0);
   std::vector<float> conn(g.n, 0.0f);   // connection of v to the growing part
   std::vector<i32> order(g.n);
   std::iota(order.begin(), order.end(), 0);
-  std::shuffle(order.begin(), order.end(), rng);
+  fy_shuffle(order, rng);
   size_t cursor = 0;
   for (int p = 0; p < k; ++p) {
     // seed: first unassigned vertex in the shuffled order
@@ -215,7 +241,7 @@ i64 edge_cut(const Graph& g, const std::vector<i32>& part) {
 // ------------------------------------------------------------ multilevel driver
 void partition_graph_ml(const Graph& g0, int k, double imbalance, int seed,
                         std::vector<i32>& part) {
-  std::mt19937 rng(seed);
+  Rng rng((uint64_t)seed);
   std::vector<Graph> levels;
   std::vector<MatchResult> maps;
   levels.push_back(g0);
@@ -273,11 +299,11 @@ Hypergraph from_cells(i32 ncells, i32 nnets, const i64* cellptr,
 }
 
 // heavy-connectivity matching: match cells sharing the most nets
-MatchResult hc_matching(const Hypergraph& h, std::mt19937& rng,
+MatchResult hc_matching(const Hypergraph& h, Rng& rng,
                         i64 big_net_threshold) {
   std::vector<i32> order(h.ncells);
   std::iota(order.begin(), order.end(), 0);
-  std::shuffle(order.begin(), order.end(), rng);
+  fy_shuffle(order, rng);
   std::vector<i32> match(h.ncells, -1);
   std::unordered_map<i32, i32> shared;
   shared.reserve(512);
@@ -374,15 +400,23 @@ void build_pincounts(const Hypergraph& h, const std::vector<i32>& part,
 }
 
 // Connectivity-aware greedy placement on the coarsest hypergraph: cells are
-// placed in random order into the part their nets already touch most, under
-// the balance cap (constructive form of the km1 gain).
+// placed in random order into the part their nets already touch most
+// (constructive form of the km1 gain).  Two placement disciplines, chosen
+// per multi-start trial for diversity:
+//   prefer_target=false — any cap-feasible part (best when the cap binds:
+//     communities fill their part to the brim before spilling);
+//   prefer_target=true — parts still under the ideal weight total/k first
+//     (best when the cap is loose: stops early parts swallowing whole
+//     neighborhoods and starving the rest).
 void greedy_grow_h(const Hypergraph& h, int k, double cap,
-                   std::vector<i32>& part, std::mt19937& rng) {
+                   std::vector<i32>& part, Rng& rng,
+                   bool prefer_target) {
   part.assign(h.ncells, -1);
   std::vector<i32> order(h.ncells);
   std::iota(order.begin(), order.end(), 0);
-  std::shuffle(order.begin(), order.end(), rng);
+  fy_shuffle(order, rng);
   std::vector<i64> pw(k, 0);
+  const i64 target = h.total_cwgt / k;
   // net -> set of parts present, tracked as dense counts
   std::vector<i32> netpart((i64)h.nnets * k, 0);
   std::vector<i64> affinity(k);
@@ -394,10 +428,16 @@ void greedy_grow_h(const Hypergraph& h, int k, double cap,
       for (int p = 0; p < k; ++p) affinity[p] += r[p] > 0;
     }
     int best = -1; i64 best_a = -1;
-    for (int p = 0; p < k; ++p)
-      if (pw[p] + h.cwgt[v] <= (i64)cap && affinity[p] > best_a) {
-        best_a = affinity[p]; best = p;
-      }
+    if (prefer_target)
+      for (int p = 0; p < k; ++p)   // first choice: parts still under target
+        if (pw[p] + h.cwgt[v] <= target && affinity[p] > best_a) {
+          best_a = affinity[p]; best = p;
+        }
+    if (best == -1)
+      for (int p = 0; p < k; ++p)   // anywhere the cap allows
+        if (pw[p] + h.cwgt[v] <= (i64)cap && affinity[p] > best_a) {
+          best_a = affinity[p]; best = p;
+        }
     if (best == -1)   // everything full (rounding): lightest part
       best = (int)(std::min_element(pw.begin(), pw.end()) - pw.begin());
     part[v] = best; pw[best] += h.cwgt[v];
@@ -406,53 +446,147 @@ void greedy_grow_h(const Hypergraph& h, int k, double cap,
   }
 }
 
-// boundary FM-style passes on km1 with dense pin counts
-void refine_km1(const Hypergraph& h, int k, double cap, std::vector<i32>& part,
-                int max_passes) {
-  PinCounts pc; pc.k = k;
-  build_pincounts(h, part, pc);
-  std::vector<i64> pw(k, 0);
-  for (i32 v = 0; v < h.ncells; ++v) pw[part[v]] += h.cwgt[v];
-  std::vector<i32> gain(k);
-  for (int pass = 0; pass < max_passes; ++pass) {
-    i64 moves = 0;
-    for (i32 v = 0; v < h.ncells; ++v) {
-      int pv = part[v];
-      // km1 gain of moving v from pv to p:
-      //   + for each net where v is pv's last pin (leaving removes pv from net)
-      //   - for each net where p has no pin yet (arriving adds p to net)
-      std::fill(gain.begin(), gain.end(), 0);
-      int leave_bonus = 0;
-      bool boundary = false;
-      for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
-        i32* r = pc.row(h.cellnets[e]);
-        if (r[pv] == 1) leave_bonus++;
-        for (int p = 0; p < k; ++p)
-          if (p != pv && r[p] > 0) { gain[p]++; boundary = true; }
-      }
-      if (!boundary) continue;
-      // gain[p] currently counts nets where p already present; real gain:
-      //   leave_bonus - (#nets of v where p absent)
-      //   = leave_bonus - (deg(v) - gain[p])
-      i64 deg = h.cellptr[v + 1] - h.cellptr[v];
-      int best = pv; i64 best_gain = 0;
-      for (int p = 0; p < k; ++p) {
-        if (p == pv) continue;
-        i64 gn = (i64)leave_bonus - (deg - (i64)gain[p]);
-        if (gn > best_gain && pw[p] + h.cwgt[v] <= (i64)cap) {
-          best_gain = gn; best = p;
-        }
-      }
-      if (best != pv) {
-        for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
-          i32* r = pc.row(h.cellnets[e]);
-          r[pv]--; r[best]++;
-        }
-        pw[pv] -= h.cwgt[v]; pw[best] += h.cwgt[v];
-        part[v] = best; ++moves;
+// km1 refinement state shared by the sweep and FM phases below.
+struct Km1Refiner {
+  const Hypergraph& h;
+  const int k;
+  const double cap;
+  std::vector<i32>& part;
+  PinCounts pc;
+  std::vector<i64> pw;
+  std::vector<i64> cnt;     // scratch: nets of v already present in part p
+
+  Km1Refiner(const Hypergraph& h_, int k_, double cap_, std::vector<i32>& part_)
+      : h(h_), k(k_), cap(cap_), part(part_), cnt(k_) {
+    pc.k = k;
+    build_pincounts(h, part, pc);
+    pw.assign(k, 0);
+    for (i32 v = 0; v < h.ncells; ++v) pw[part[v]] += h.cwgt[v];
+  }
+
+  // Best feasible move for v.  km1 gain of moving v from pv to p:
+  //   + every net where v is pv's last pin (leaving removes pv from the net)
+  //   - every net where p has no pin yet (arriving adds p to the net)
+  //   = leave_bonus - (deg(v) - #nets of v where p already present).
+  // Ties prefer the lighter target part.  target = -1 when v is interior or
+  // no part has room.
+  i64 best_move(i32 v, i32& target) {
+    const int pv = part[v];
+    std::fill(cnt.begin(), cnt.end(), 0);
+    i64 leave_bonus = 0;
+    for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
+      const i32* r = pc.row(h.cellnets[e]);
+      if (r[pv] == 1) leave_bonus++;
+      for (int p = 0; p < k; ++p)
+        if (p != pv && r[p] > 0) cnt[p]++;
+    }
+    const i64 deg = h.cellptr[v + 1] - h.cellptr[v];
+    target = -1;
+    i64 best_gain = 0;
+    bool boundary = false;
+    for (int p = 0; p < k; ++p) {
+      if (p == pv) continue;
+      if (cnt[p] > 0) boundary = true;
+      if (pw[p] + h.cwgt[v] > (i64)cap) continue;
+      i64 gn = leave_bonus - (deg - cnt[p]);
+      if (target == -1 || gn > best_gain ||
+          (gn == best_gain && pw[p] < pw[target])) {
+        best_gain = gn; target = p;
       }
     }
-    if (moves == 0) break;
+    if (!boundary) target = -1;
+    return target == -1 ? 0 : best_gain;
+  }
+
+  void apply(i32 v, i32 to) {
+    const int pv = part[v];
+    for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
+      i32* r = pc.row(h.cellnets[e]);
+      r[pv]--; r[to]++;
+    }
+    pw[pv] -= h.cwgt[v]; pw[to] += h.cwgt[v];
+    part[v] = to;
+  }
+
+  // Greedy boundary sweeps: linear-time passes applying only positive-gain
+  // moves in cell order; converge fast and carry the bulk of refinement at
+  // every scale.
+  void sweeps(int max_passes) {
+    for (int pass = 0; pass < max_passes; ++pass) {
+      i64 moves = 0;
+      for (i32 v = 0; v < h.ncells; ++v) {
+        i32 t; i64 g = best_move(v, t);
+        if (t >= 0 && g > 0) { apply(v, t); ++moves; }
+      }
+      if (moves == 0) break;
+    }
+  }
+
+  // One FM hill-climbing pass (the gain-ordered refinement of the
+  // PaToH/KaHyPar family).  A lazy max-heap replaces classic gain-bucket
+  // arrays — k-way km1 gains are not small bounded integers, and the heap
+  // keeps the balance-aware tie-break explicit:
+  //   * seed with every boundary cell's best feasible move,
+  //   * repeatedly apply the globally best move, negative gains included
+  //     (the hill-climbing a greedy sweep lacks), locking moved cells,
+  //   * remember the best prefix of the move sequence, roll back past it.
+  // Deterministic: no randomness; heap ties resolve on (gain, cell, target).
+  // Cost is bounded (drift window + move cap) so the multilevel driver can
+  // afford it above the coarsest level.
+  i64 fm_pass() {
+    struct Move { i32 cell, from; };
+    using Entry = std::tuple<i64, i32, i32>;        // (gain, cell, target)
+    std::priority_queue<Entry> heap;
+    std::vector<char> locked(h.ncells, 0);
+    for (i32 v = 0; v < h.ncells; ++v) {
+      i32 t; i64 g = best_move(v, t);
+      if (t >= 0) heap.emplace(g, v, t);
+    }
+    std::vector<Move> moves;
+    i64 cum = 0, best_cum = 0;
+    size_t best_len = 0;
+    int since_best = 0;
+    const int drift =                               // hill-climb tolerance
+        std::max(30, std::min(h.ncells / 16, 256));
+    while (!heap.empty() && since_best < drift &&
+           moves.size() < (size_t)h.ncells) {
+      auto [g, v, t] = heap.top(); heap.pop();
+      if (locked[v]) continue;
+      i32 t2; i64 g2 = best_move(v, t2);
+      if (t2 < 0) continue;
+      if (g2 != g || t2 != t) {                     // stale: requeue current
+        heap.emplace(g2, v, t2);
+        continue;
+      }
+      moves.push_back({v, part[v]});
+      apply(v, t);
+      locked[v] = 1;
+      cum += g;
+      if (cum > best_cum) { best_cum = cum; best_len = moves.size(); since_best = 0; }
+      else ++since_best;
+      // Neighbors' gains drifted, but we deliberately do NOT eagerly
+      // recompute them: on coarse hypergraphs a merged cell touches
+      // thousands of nets and eager requeue is O(deg·pins·deg·k) per move.
+      // Stale entries revalidate on pop (g2/t2 check above), and the
+      // surrounding pass loop reseeds the heap from scratch, so improved
+      // cells are never lost — only serviced slightly later.
+    }
+    for (size_t i = moves.size(); i > best_len; --i)
+      apply(moves[i - 1].cell, moves[i - 1].from);  // roll back past the peak
+    return best_cum;
+  }
+};
+
+// Combined refinement: fast convergent sweeps always; FM hill-climbing where
+// the instance size affords it, with sweeps mopping up after each FM gain.
+void refine_km1(const Hypergraph& h, int k, double cap, std::vector<i32>& part,
+                int max_passes) {
+  Km1Refiner r(h, k, cap, part);
+  r.sweeps(max_passes);
+  if (h.ncells > 50000) return;
+  for (int pass = 0; pass < std::min(max_passes, 4); ++pass) {
+    if (r.fm_pass() <= 0) break;
+    r.sweeps(2);
   }
 }
 
@@ -504,7 +638,13 @@ void rebalance_km1(const Hypergraph& h, int k, double cap,
 
 void partition_hypergraph_ml(const Hypergraph& h0, int k, double imbalance,
                              int seed, std::vector<i32>& part) {
-  std::mt19937 rng(seed);
+  const bool timing = std::getenv("SGCN_TIMING") != nullptr;
+  auto now = [] { return std::chrono::steady_clock::now(); };
+  auto secs = [](auto a, auto b) {
+    return std::chrono::duration<double>(b - a).count();
+  };
+  auto t0 = now();
+  Rng rng((uint64_t)seed);
   std::vector<Hypergraph> levels;
   std::vector<MatchResult> maps;
   levels.push_back(h0);
@@ -520,6 +660,9 @@ void partition_hypergraph_ml(const Hypergraph& h0, int k, double imbalance,
     maps.push_back(std::move(m));
     levels.push_back(std::move(c));
   }
+  if (timing)
+    std::fprintf(stderr, "[sgcnpart] coarsen: %.2fs levels=%zu coarsest=%d\n",
+                 secs(t0, now()), levels.size(), levels.back().ncells);
   double cap = (1.0 + imbalance) * (double)h0.total_cwgt / k;
   // multi-start at the coarsest level: keep the best refined candidate
   {
@@ -531,8 +674,8 @@ void partition_hypergraph_ml(const Hypergraph& h0, int k, double imbalance,
     PinCounts pc; pc.k = k;
     for (int trial = 0; trial < 8; ++trial) {
       std::vector<i32> cand;
-      greedy_grow_h(hc, k, coarse_cap, cand, rng);
-      refine_km1(hc, k, coarse_cap, cand, 12);
+      greedy_grow_h(hc, k, coarse_cap, cand, rng, trial % 2 == 1);
+      refine_km1(hc, k, coarse_cap, cand, 8);
       build_pincounts(hc, cand, pc);
       i64 score = km1_total(hc, pc);
       if (best_km1 < 0 || score < best_km1) {
@@ -541,15 +684,25 @@ void partition_hypergraph_ml(const Hypergraph& h0, int k, double imbalance,
     }
     part = std::move(best_part);
   }
+  if (timing)
+    std::fprintf(stderr, "[sgcnpart] coarse multistart: %.2fs\n", secs(t0, now()));
   for (int li = (int)levels.size() - 2; li >= 0; --li) {
+    auto tl = now();
     const MatchResult& m = maps[li];
     std::vector<i32> fine(levels[li].ncells);
     for (i32 v = 0; v < levels[li].ncells; ++v) fine[v] = part[m.cmap[v]];
     part = std::move(fine);
-    refine_km1(levels[li], k, cap, part, li == 0 ? 10 : 5);
+    refine_km1(levels[li], k, cap, part, li == 0 ? 6 : 3);
+    if (timing)
+      std::fprintf(stderr, "[sgcnpart] level %d (n=%d): %.2fs\n", li,
+                   levels[li].ncells, secs(tl, now()));
   }
+  auto tr = now();
   rebalance_km1(h0, k, cap, part);
-  refine_km1(h0, k, cap, part, 4);
+  refine_km1(h0, k, cap, part, 3);
+  if (timing)
+    std::fprintf(stderr, "[sgcnpart] rebalance+final: %.2fs total=%.2fs\n",
+                 secs(tr, now()), secs(t0, now()));
 }
 
 }  // namespace
@@ -593,7 +746,22 @@ int sgcn_partition_hypergraph(i32 ncells, i32 nnets, const i64* cellptr,
   Hypergraph h = from_cells(ncells, nnets, cellptr, cellnets, cwgt);
   std::vector<i32> part;
   if (k == 1) part.assign(ncells, 0);
-  else partition_hypergraph_ml(h, k, imbalance, seed, part);
+  else {
+    // restarts of the whole multilevel procedure (different coarsening and
+    // seeding draws); keep the best final km1 — the "more V-cycles /
+    // restarts" quality lever of the PaToH quality preset.  Small instances
+    // are cheap enough to search harder.
+    const int restarts = ncells <= 20000 ? 6 : 3;
+    i64 best = -1;
+    std::vector<i32> cand;
+    PinCounts pc; pc.k = k;
+    for (int r = 0; r < restarts; ++r) {
+      partition_hypergraph_ml(h, k, imbalance, seed + 7919 * r, cand);
+      build_pincounts(h, cand, pc);
+      i64 score = km1_total(h, pc);
+      if (best < 0 || score < best) { best = score; part = cand; }
+    }
+  }
   std::copy(part.begin(), part.end(), part_out);
   if (km1_out) {
     PinCounts pc; pc.k = k;
@@ -619,19 +787,19 @@ int sgcn_read_mtx(const char* path, i64* nrows_out, i64* ncols_out,
                   float** val_out) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return 1;
-  if (std::fseek(f, 0, SEEK_END) != 0) { std::fclose(f); return 1; }
-  long fsize = std::ftell(f);
-  if (fsize < 0 || std::fseek(f, 0, SEEK_SET) != 0) {
-    std::fclose(f);
-    return 1;
-  }
-  std::vector<char> buf((size_t)fsize + 1);
-  if (fsize > 0 && std::fread(buf.data(), 1, fsize, f) != (size_t)fsize) {
-    std::fclose(f);
-    return 1;
+  // 64-bit size probe: long is 32-bit on LLP64, so >2 GiB files would
+  // overflow a plain ftell there; read in chunks until EOF instead.
+  std::vector<char> buf;
+  {
+    std::vector<char> chunk(1 << 20);   // heap: callers may run on small stacks
+    size_t got;
+    while ((got = std::fread(chunk.data(), 1, chunk.size(), f)) > 0)
+      buf.insert(buf.end(), chunk.data(), chunk.data() + got);
+    if (std::ferror(f)) { std::fclose(f); return 1; }
   }
   std::fclose(f);
-  buf[fsize] = '\0';
+  const size_t fsize = buf.size();
+  buf.push_back('\0');
 
   const char* p = buf.data();
   const char* end = p + fsize;
@@ -783,8 +951,8 @@ int main(int argc, char** argv) {
   i64 metric = 0;
   auto t0 = std::chrono::steady_clock::now();
   if (mode == 'r') {
-    std::mt19937 rng(seed);
-    for (i32 v = 0; v < n; ++v) part[v] = (i32)(rng() % k);
+    Rng rng((uint64_t)seed);
+    for (i32 v = 0; v < n; ++v) part[v] = (i32)rng.below(k);
   } else if (mode == 'g') {
     // symmetrize into CSR (graph model), dedup'd: the reader already expands
     // symmetric storage, and general files may list both directions
