@@ -791,6 +791,16 @@ int sgcn_read_mtx(const char* path, i64* nrows_out, i64* ncols_out,
   // overflow a plain ftell there; read in chunks until EOF instead.
   std::vector<char> buf;
   {
+#if defined(_WIN32)
+    if (_fseeki64(f, 0, SEEK_END) == 0) {
+      long long sz = _ftelli64(f);
+#else
+    if (fseeko(f, 0, SEEK_END) == 0) {
+      off_t sz = ftello(f);
+#endif
+      if (sz > 0) buf.reserve((size_t)sz + 1);   // one allocation, no 2x peak
+    }
+    std::rewind(f);
     std::vector<char> chunk(1 << 20);   // heap: callers may run on small stacks
     size_t got;
     while ((got = std::fread(chunk.data(), 1, chunk.size(), f)) > 0)

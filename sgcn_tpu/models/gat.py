@@ -30,6 +30,9 @@ from ..ops.pspmm import halo_exchange
 from ..parallel.mesh import AXIS
 from .activations import get_activation
 
+# plan arrays the GAT forward consumes (fullbatch ships exactly these)
+GAT_PLAN_FIELDS = ("send_idx", "halo_src", "edge_dst", "edge_src", "edge_w")
+
 _NEG = -1e30
 
 
@@ -96,8 +99,7 @@ def gat_layer_local(
 def gat_forward_local(
     params,
     h,
-    send_idx, halo_src,
-    edge_dst, edge_src, edge_w,
+    pa,                           # plan arrays dict (GAT_PLAN_FIELDS)
     activation: str = "none",
     final_activation: str = "none",
     axis_name: str = AXIS,
@@ -107,6 +109,10 @@ def gat_forward_local(
     The reference stacks bare PGAT modules with no inter-layer nonlinearity
     (softmax-weighted aggregation is the nonlinearity, ``GPU/PGAT.py:202-213``);
     ``activation='elu'`` gives the standard GAT variant.
+
+    GAT keeps the combined ``[local; halo]`` edge list (not the split
+    overlap form): the edge-softmax normalizes each row over local AND halo
+    edges together, so the aggregation genuinely depends on the exchange.
     """
     act = get_activation(activation)
     fact = get_activation(final_activation)
@@ -114,7 +120,8 @@ def gat_forward_local(
     for i, p in enumerate(params):
         h = gat_layer_local(
             p["w"], p["a1"], p["a2"], h,
-            send_idx, halo_src, edge_dst, edge_src, edge_w,
+            pa["send_idx"], pa["halo_src"],
+            pa["edge_dst"], pa["edge_src"], pa["edge_w"],
             axis_name=axis_name)
         h = fact(h) if i == nl - 1 else act(h)
     return h

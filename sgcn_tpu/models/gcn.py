@@ -21,9 +21,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.pspmm import pspmm_exchange
+from ..ops.pspmm import pspmm_overlap
 from ..parallel.mesh import AXIS
 from .activations import get_activation
+
+# plan arrays the GCN forward consumes (fullbatch ships exactly these)
+GCN_PLAN_FIELDS = ("send_idx", "halo_src", "ledge_dst", "ledge_src", "ledge_w",
+                   "hedge_dst", "hedge_src", "hedge_w")
 
 # minimum input width (f32 elements) for the project-before-aggregate layer
 # order to win: below this, random row gathers are HBM-access-bound, so
@@ -48,13 +52,17 @@ def init_gcn_params(rng: jax.Array, dims: list[tuple[int, int]]):
 def gcn_forward_local(
     params,
     h,                      # (B, f_in) local feature rows
-    send_idx, halo_src,     # halo-exchange plan (k, S) / (R,)
-    edge_dst, edge_src, edge_w,   # local padded edge lists (E,)
+    pa,                     # plan arrays dict (GCN_PLAN_FIELDS)
     activation: str = "relu",
     final_activation: str = "none",
     axis_name: str = AXIS,
 ):
     """Per-chip forward: L × (pspmm ⊗ dense matmul → activation) → (B, nout).
+
+    Aggregation uses ``pspmm_overlap`` — the split-edge-list formulation in
+    which the local SpMM has no data dependence on the halo ``all_to_all``,
+    so XLA overlaps communication with compute the way the MPI trainer's
+    Irecv/compute/Waitany loop does (``Parallel-GCN/main.c:238-299``).
 
     Op order per layer exploits associativity: ``(Â·H)·W = Â·(H·W)``.  When
     the input is wide and the output narrower, the dense projection runs
@@ -69,14 +77,19 @@ def gcn_forward_local(
     act = get_activation(activation)
     fact = get_activation(final_activation)
     nl = len(params)
+
+    def agg(x):
+        return pspmm_overlap(
+            x, pa["send_idx"], pa["halo_src"],
+            pa["ledge_dst"], pa["ledge_src"], pa["ledge_w"],
+            pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
+            axis_name=axis_name)
+
     for i, w in enumerate(params):
         if w.shape[1] < h.shape[1] and h.shape[1] >= PROJECT_FIRST_MIN_FIN:
-            z = pspmm_exchange(h @ w, send_idx, halo_src,
-                               edge_dst, edge_src, edge_w, axis_name=axis_name)
+            z = agg(h @ w)
         else:
-            z = pspmm_exchange(h, send_idx, halo_src,
-                               edge_dst, edge_src, edge_w,
-                               axis_name=axis_name) @ w
+            z = agg(h) @ w
         h = fact(z) if i == nl - 1 else act(z)
     return h
 

@@ -1,3 +1,5 @@
-from .pspmm import halo_exchange, spmm_local, pspmm, pspmm_exchange
+from .pspmm import (halo_exchange, spmm_local, pspmm, pspmm_exchange,
+                    pspmm_overlap)
 
-__all__ = ["halo_exchange", "spmm_local", "pspmm", "pspmm_exchange"]
+__all__ = ["halo_exchange", "spmm_local", "pspmm", "pspmm_exchange",
+           "pspmm_overlap"]

@@ -76,6 +76,37 @@ def pspmm(h, halo, edge_dst, edge_src, edge_w):
 
 def pspmm_exchange(h, send_idx, halo_src, edge_dst, edge_src, edge_w,
                    axis_name: str = AXIS):
-    """Full ``PSpMM``: halo exchange + local SpMM (the per-layer hot path)."""
+    """``PSpMM`` over the combined ``[h; halo]`` edge list.
+
+    Every edge's gather depends on the exchanged halo, so XLA cannot start
+    the SpMM until the ``all_to_all`` lands.  Kept for ops that genuinely
+    need the combined table (the GAT edge-softmax normalizes over local and
+    halo edges together); the GCN hot path uses ``pspmm_overlap``.
+    """
     halo = halo_exchange(h, send_idx, halo_src, axis_name)
     return pspmm(h, halo, edge_dst, edge_src, edge_w)
+
+
+def pspmm_overlap(h, send_idx, halo_src,
+                  ledge_dst, ledge_src, ledge_w,
+                  hedge_dst, hedge_src, hedge_w,
+                  axis_name: str = AXIS):
+    """``PSpMM`` with the reference's comm/compute-overlap structure.
+
+    The edge list is split at plan time by source locality
+    (``sgcn_tpu.parallel.plan``): the local-src segment-sum reads only ``h``
+    and therefore has no data dependence on the ``all_to_all`` — XLA is free
+    to run it while boundary rows are in flight — after which the halo-src
+    segment-sum folds in the remote contribution.  This is exactly
+    ``AH = Â·H_local + Σ_r Â·Ĥ_r`` of the MPI trainer
+    (``Parallel-GCN/main.c:238-299``: post Irecv, compute local SpMM, fold
+    arrivals), expressed as a dependence structure instead of explicit waits.
+
+    Under JAX transposition the backward keeps the same split: the gradient
+    all_to_all overlaps with the local-src transpose-SpMM.
+    """
+    halo = halo_exchange(h, send_idx, halo_src, axis_name)
+    # no data dependence on `halo` — XLA overlaps this with the exchange
+    local = spmm_local(ledge_dst, ledge_src, ledge_w, h, h.shape[0])
+    remote = spmm_local(hedge_dst, hedge_src, hedge_w, halo, h.shape[0])
+    return local + remote

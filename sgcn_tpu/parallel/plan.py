@@ -73,6 +73,23 @@ class CommPlan:
 
     row_valid: np.ndarray     # (k, B) float32 1/0 mask of real (non-pad) rows
 
+    # The same edges split by source locality — the overlap structure of the
+    # reference's forward (``Parallel-GCN/main.c:238-299``): the local-src
+    # segment-sum depends only on ``h``, so XLA can run it while the halo
+    # all_to_all is in flight, then the halo-src segment-sum folds the remote
+    # contribution in (``AH = Â·H_local + Σ Â·Ĥ_r``).  ``ledge_src`` indexes
+    # local rows [0, B); ``hedge_src`` indexes the halo block [0, R).
+    el: int                   # padded local-src nnz per chip
+    eh: int                   # padded halo-src nnz per chip
+    ledge_dst: np.ndarray     # (k, EL) int32
+    ledge_src: np.ndarray     # (k, EL) int32
+    ledge_w: np.ndarray       # (k, EL) float32, 0 on padding
+    hedge_dst: np.ndarray     # (k, EH) int32
+    hedge_src: np.ndarray     # (k, EH) int32
+    hedge_w: np.ndarray       # (k, EH) float32, 0 on padding
+    lnnz: np.ndarray          # (k,) true local-src nnz
+    hnnz: np.ndarray          # (k,) true halo-src nnz
+
     # ------------------------------------------------------------------ stats
     @property
     def predicted_send_volume(self) -> np.ndarray:
@@ -133,6 +150,47 @@ def _relabel(n: int, partvec: np.ndarray, k: int, pad_rows_to: int):
     return owner, local_idx, part_sizes, b, row_valid
 
 
+def _split_edges(edge_dst, edge_src, edge_w, nnz, b,
+                 el: int | None = None, eh: int | None = None):
+    """Split padded (k, E) edge lists into local-src and halo-src lists.
+
+    Local edges (``src < b``) keep their src; halo edges re-base src to the
+    halo block (``src - b``).  Filtering preserves the sorted-by-dst
+    invariant.  ``el`` / ``eh`` force a larger padded width (shared
+    compilation envelopes); padding edges carry dst ``b-1`` and weight 0.
+    """
+    k = edge_dst.shape[0]
+    parts = []
+    for p in range(k):
+        cnt = int(nnz[p])
+        d, s0, w = edge_dst[p, :cnt], edge_src[p, :cnt], edge_w[p, :cnt]
+        lm = s0 < b
+        parts.append((d[lm], s0[lm], w[lm], d[~lm], s0[~lm] - b, w[~lm]))
+    lnnz = np.array([len(t[0]) for t in parts], dtype=np.int64)
+    hnnz = np.array([len(t[3]) for t in parts], dtype=np.int64)
+    el_nat = max(1, int(lnnz.max()) if k else 1)
+    eh_nat = max(1, int(hnnz.max()) if k else 1)
+    el = el_nat if el is None else el
+    eh = eh_nat if eh is None else eh
+    if el < el_nat or eh < eh_nat:
+        raise ValueError("split envelope smaller than natural edge counts")
+    ld = np.full((k, el), b - 1, dtype=np.int32)
+    ls = np.zeros((k, el), dtype=np.int32)
+    lw = np.zeros((k, el), dtype=np.float32)
+    hd = np.full((k, eh), b - 1, dtype=np.int32)
+    hs = np.zeros((k, eh), dtype=np.int32)
+    hw = np.zeros((k, eh), dtype=np.float32)
+    for p, (d1, s1, w1, d2, s2, w2) in enumerate(parts):
+        ld[p, : len(d1)] = d1
+        ls[p, : len(s1)] = s1
+        lw[p, : len(w1)] = w1
+        hd[p, : len(d2)] = d2
+        hs[p, : len(s2)] = s2
+        hw[p, : len(w2)] = w2
+    return dict(el=el, eh=eh, ledge_dst=ld, ledge_src=ls, ledge_w=lw,
+                hedge_dst=hd, hedge_src=hs, hedge_w=hw, lnnz=lnnz, hnnz=hnnz)
+
+
 def relabel_plan(a: sp.spmatrix, partvec: np.ndarray, k: int,
                  pad_rows_to: int = 1) -> CommPlan:
     """Vertex relabeling + padding fields only — no halo/send construction.
@@ -158,10 +216,17 @@ def relabel_plan(a: sp.spmatrix, partvec: np.ndarray, k: int,
         edge_dst=z((k, e), np.int32), edge_src=z((k, e), np.int32),
         edge_w=z((k, e), np.float32), nnz=nnz.astype(np.int64),
         row_valid=row_valid,
+        el=1, eh=1,
+        ledge_dst=z((k, 1), np.int32), ledge_src=z((k, 1), np.int32),
+        ledge_w=z((k, 1), np.float32),
+        hedge_dst=z((k, 1), np.int32), hedge_src=z((k, 1), np.int32),
+        hedge_w=z((k, 1), np.float32),
+        lnnz=z(k, np.int64), hnnz=z(k, np.int64),
     )
 
 
-def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int) -> CommPlan:
+def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
+                  el: int | None = None, eh: int | None = None) -> CommPlan:
     """Re-pad a plan to a larger (B, S, R, E) envelope.
 
     Lets many plans (one per mini-batch) share ONE compiled train step: the
@@ -172,9 +237,13 @@ def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int) -> CommPlan:
     weight 0 and dst ``b-1`` (keeps ``edge_dst`` non-decreasing), pad send /
     halo slots index row 0 and are never read by valid gathers.
     """
-    if (b, s, r, e) == (plan.b, plan.s, plan.r, plan.e):
+    el = plan.el if el is None else el
+    eh = plan.eh if eh is None else eh
+    if (b, s, r, e, el, eh) == (plan.b, plan.s, plan.r, plan.e,
+                                plan.el, plan.eh):
         return plan
-    if b < plan.b or s < plan.s or r < plan.r or e < plan.e:
+    if (b < plan.b or s < plan.s or r < plan.r or e < plan.e
+            or el < plan.el or eh < plan.eh):
         raise ValueError("pad_comm_plan cannot shrink an envelope")
     k = plan.k
 
@@ -207,6 +276,7 @@ def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int) -> CommPlan:
         halo_src=halo_src, halo_counts=plan.halo_counts.copy(),
         edge_dst=edge_dst, edge_src=edge_src, edge_w=edge_w,
         nnz=plan.nnz.copy(), row_valid=row_valid,
+        **_split_edges(edge_dst, edge_src, edge_w, plan.nnz, b, el=el, eh=eh),
     )
 
 
@@ -315,4 +385,5 @@ def build_comm_plan(
         halo_src=halo_src, halo_counts=halo_counts,
         edge_dst=edge_dst, edge_src=edge_src, edge_w=edge_w,
         nnz=nnz.astype(np.int64), row_valid=row_valid,
+        **_split_edges(edge_dst, edge_src, edge_w, nnz, b),
     )

@@ -32,8 +32,9 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..models.gat import gat_forward_local, init_gat_params
+from ..models.gat import GAT_PLAN_FIELDS, gat_forward_local, init_gat_params
 from ..models.gcn import (
+    GCN_PLAN_FIELDS,
     gcn_forward_local,
     init_gcn_params,
     masked_accuracy_local,
@@ -43,12 +44,14 @@ from ..parallel.mesh import AXIS, make_mesh_1d, replicate, shard_stacked
 from ..parallel.plan import CommPlan
 from ..utils.stats import CommStats
 
-# model registry: name → (param init, per-chip forward). GAT is the reference's
-# PGAT capability (GPU/PGAT.py) on the same trainer scaffold — like the
-# reference, only the nn.Module differs between PGCN.py and PGAT.py.
+# model registry: name → (param init, per-chip forward, plan fields shipped
+# to the device). GAT is the reference's PGAT capability (GPU/PGAT.py) on the
+# same trainer scaffold — like the reference, only the nn.Module differs
+# between PGCN.py and PGAT.py. GCN ships the split (overlap) edge lists, GAT
+# the combined ones its edge-softmax needs.
 MODELS = {
-    "gcn": (init_gcn_params, gcn_forward_local),
-    "gat": (init_gat_params, gat_forward_local),
+    "gcn": (init_gcn_params, gcn_forward_local, GCN_PLAN_FIELDS),
+    "gat": (init_gat_params, gat_forward_local, GAT_PLAN_FIELDS),
 }
 
 
@@ -84,14 +87,8 @@ def make_train_data(
     return TrainData(h0=h0, labels=lab, train_valid=tv, eval_valid=ev)
 
 
-def _plan_arrays(plan: CommPlan) -> dict:
-    return {
-        "send_idx": plan.send_idx,
-        "halo_src": plan.halo_src,
-        "edge_dst": plan.edge_dst,
-        "edge_src": plan.edge_src,
-        "edge_w": plan.edge_w,
-    }
+def _plan_arrays(plan: CommPlan, fields) -> dict:
+    return {f: getattr(plan, f) for f in fields}
 
 
 def _unblock(tree):
@@ -133,7 +130,7 @@ class FullBatchTrainer:
         self.final_activation = final_activation
         self.compute_dtype = compute_dtype
         self.remat = remat
-        init_fn, self._forward_fn = MODELS[model]
+        init_fn, self._forward_fn, self.plan_fields = MODELS[model]
         self.model = model
         dims = list(zip([fin] + widths[:-1], widths))
         self.params = init_fn(jax.random.PRNGKey(seed), dims)
@@ -141,7 +138,7 @@ class FullBatchTrainer:
         self.opt_state = self.opt.init(self.params)
         self.params = replicate(self.mesh, self.params)
         self.opt_state = replicate(self.mesh, self.opt_state)
-        self.pa = shard_stacked(self.mesh, _plan_arrays(plan))
+        self.pa = shard_stacked(self.mesh, _plan_arrays(plan, self.plan_fields))
         self.stats = CommStats.from_plan(plan)
         self._step = self._build_step()
         self._eval = self._build_eval()
@@ -153,11 +150,10 @@ class FullBatchTrainer:
             dt = jnp.dtype(self.compute_dtype)
             params = jax.tree.map(lambda w: w.astype(dt), params)
             h0 = h0.astype(dt)
-            pa = {**pa, "edge_w": pa["edge_w"].astype(dt)}
+            pa = {k: v.astype(dt) if v.dtype == jnp.float32 else v
+                  for k, v in pa.items()}
         out = self._forward_fn(
-            params, h0,
-            pa["send_idx"], pa["halo_src"],
-            pa["edge_dst"], pa["edge_src"], pa["edge_w"],
+            params, h0, pa,
             activation=self.activation,
             final_activation=self.final_activation,
         )

@@ -30,7 +30,8 @@ import scipy.sparse as sp
 
 from ..parallel.mesh import make_mesh_1d, shard_stacked
 from ..parallel.plan import build_comm_plan, pad_comm_plan
-from .fullbatch import FullBatchTrainer, TrainData, _plan_arrays, make_train_data
+from .fullbatch import (FullBatchTrainer, TrainData, _plan_arrays,
+                        make_train_data)
 
 
 def sample_batches(n: int, batch_size: int, nbatches: int | None = None,
@@ -96,7 +97,8 @@ class MiniBatchTrainer:
             # remap part ids unchanged: chips keep their global rank even if a
             # batch misses some part entirely
             raw.append(build_comm_plan(sub, pv, k, pad_rows_to=pad_rows_to))
-        env = tuple(max(getattr(p, f) for p in raw) for f in ("b", "s", "r", "e"))
+        env = tuple(max(getattr(p, f) for p in raw)
+                    for f in ("b", "s", "r", "e", "el", "eh"))
         self.plans = [pad_comm_plan(p, *env) for p in raw]
 
         # one inner trainer = one compiled step for every batch
@@ -119,7 +121,8 @@ class MiniBatchTrainer:
             out.append(Batch(
                 vertices=bv,
                 plan=plan,
-                pa=shard_stacked(self.mesh, _plan_arrays(plan)),
+                pa=shard_stacked(self.mesh,
+                                 _plan_arrays(plan, self.inner.plan_fields)),
                 data=TrainData(**shard_stacked(self.mesh, vars(data))),
             ))
         return out

@@ -10,9 +10,15 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
-from sgcn_tpu.ops import pspmm_exchange
+from sgcn_tpu.ops import pspmm_exchange, pspmm_overlap
 from sgcn_tpu.parallel import build_comm_plan, make_mesh_1d, shard_stacked
 from sgcn_tpu.partition import balanced_random_partition, random_partition
+
+from sgcn_tpu.models.gcn import GCN_PLAN_FIELDS as OVERLAP_FIELDS
+
+
+def _overlap_args(pa):
+    return tuple(pa[f] for f in OVERLAP_FIELDS)
 
 
 def _run_pspmm(plan, mesh, h_global, f):
@@ -51,6 +57,129 @@ def test_forward_parity(ahat, k, partfn):
     got = plan.gather_rows(out_blocks)
     expected = ahat @ h
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("k,partfn", [(2, balanced_random_partition),
+                                      (4, balanced_random_partition),
+                                      (8, random_partition)])
+def test_overlap_forward_parity(ahat, k, partfn):
+    """The split-edge-list (comm/compute-overlap) formulation must compute the
+    same Â·H: Â·H_local + Σ Â·Ĥ_r (Parallel-GCN/main.c:238-299)."""
+    n = ahat.shape[0]
+    f = 5
+    pv = partfn(n, k, seed=11)
+    plan = build_comm_plan(ahat, pv, k)
+    # split invariants: every edge lands in exactly one of the two lists
+    np.testing.assert_array_equal(plan.lnnz + plan.hnnz, plan.nnz)
+    assert (plan.ledge_src < plan.b).all()
+    assert (plan.hedge_src < plan.r).all()
+    mesh = make_mesh_1d(k)
+    h = np.random.default_rng(4).standard_normal((n, f)).astype(np.float32)
+    h_blocks = shard_stacked(mesh, plan.scatter_rows(h))
+    pa = shard_stacked(mesh, {f_: getattr(plan, f_) for f_ in OVERLAP_FIELDS})
+
+    def per_chip(pa, h):
+        pa = jax.tree.map(lambda x: x[0], pa)
+        return pspmm_overlap(h[0], *_overlap_args(pa))[None]
+
+    fn = jax.jit(jax.shard_map(per_chip, mesh=mesh,
+                               in_specs=(P("v"), P("v")),
+                               out_specs=P("v")))
+    got = plan.gather_rows(np.asarray(fn(pa, h_blocks)))
+    np.testing.assert_allclose(got, ahat @ h, rtol=1e-4, atol=1e-5)
+
+
+def test_overlap_backward_parity(ahat):
+    """Gradient through pspmm_overlap must equal Âᵀ·w, covering the
+    transposed all_to_all of the split formulation."""
+    n = ahat.shape[0]
+    k = 4
+    f = 3
+    pv = balanced_random_partition(n, k, seed=13)
+    plan = build_comm_plan(ahat, pv, k)
+    mesh = make_mesh_1d(k)
+    rng = np.random.default_rng(7)
+    h = rng.standard_normal((n, f)).astype(np.float32)
+    wgt = rng.standard_normal((n, f)).astype(np.float32)
+    pa = shard_stacked(mesh, {f_: getattr(plan, f_) for f_ in OVERLAP_FIELDS})
+    hb = shard_stacked(mesh, plan.scatter_rows(h))
+    wb = shard_stacked(mesh, plan.scatter_rows(wgt))
+
+    def per_chip(pa, h, w):
+        pa = jax.tree.map(lambda x: x[0], pa)
+
+        def obj(hl):
+            out = pspmm_overlap(hl, *_overlap_args(pa))
+            return jax.lax.psum(jnp.sum(out * w[0]), "v")
+
+        return jax.grad(obj)(h[0])[None]
+
+    fn = jax.jit(jax.shard_map(per_chip, mesh=mesh,
+                               in_specs=(P("v"), P("v"), P("v")),
+                               out_specs=P("v")))
+    got = plan.gather_rows(np.asarray(fn(pa, hb, wb)))
+    np.testing.assert_allclose(got, ahat.T @ wgt, rtol=1e-4, atol=1e-5)
+
+
+def _collective_taint(jaxpr):
+    """(tainted_eqns, eqns): which inner-jaxpr eqns transitively depend on the
+    all_to_all collective (var-level dataflow taint)."""
+    from jax.extend.core import Literal
+    inner = None
+    for e in jaxpr.eqns:
+        if "shard" in e.primitive.name:
+            inner = e.params["jaxpr"]
+    assert inner is not None
+    tainted_vars: set = set()
+    tainted_eqns = []
+    for e in inner.eqns:
+        invars = [v for v in e.invars if not isinstance(v, Literal)]
+        hit = e.primitive.name == "all_to_all" or any(
+            v in tainted_vars for v in invars)
+        if hit:
+            tainted_vars.update(e.outvars)
+            tainted_eqns.append(e)
+    return tainted_eqns, inner.eqns
+
+
+def test_overlap_local_spmm_independent_of_collective(ahat):
+    """The overlap property itself: in the split formulation the local
+    segment-sum (scatter-add) must NOT depend on the all_to_all — that
+    dependence freedom is what lets the TPU scheduler hide the exchange
+    behind local compute (the Irecv/compute/Waitany structure of
+    Parallel-GCN/main.c:238-299).  The combined formulation, by contrast,
+    aggregates through the concatenated [h; halo] table, so every
+    scatter-add depends on the collective."""
+    n = ahat.shape[0]
+    k = 4
+    plan = build_comm_plan(ahat, balanced_random_partition(n, k, seed=1), k)
+    mesh = make_mesh_1d(k)
+    h = np.zeros((k, plan.b, 5), np.float32)
+    pao = {f: getattr(plan, f) for f in OVERLAP_FIELDS}
+    pac = {f: getattr(plan, f)
+           for f in ("send_idx", "halo_src", "edge_dst", "edge_src", "edge_w")}
+
+    def overlap_chip(pa, h):
+        pa = jax.tree.map(lambda x: x[0], pa)
+        return pspmm_overlap(h[0], *_overlap_args(pa))[None]
+
+    def combined_chip(pa, h):
+        pa = jax.tree.map(lambda x: x[0], pa)
+        return pspmm_exchange(h[0], pa["send_idx"], pa["halo_src"],
+                              pa["edge_dst"], pa["edge_src"], pa["edge_w"])[None]
+
+    def agg_taint(fn, pa):
+        sm = jax.shard_map(fn, mesh=mesh, in_specs=(P("v"), P("v")),
+                           out_specs=P("v"))
+        tainted, eqns = _collective_taint(jax.make_jaxpr(sm)(pa, h))
+        aggs = [e for e in eqns if "scatter" in e.primitive.name]
+        assert aggs, "expected scatter-add aggregation eqns in the jaxpr"
+        return [e in tainted for e in aggs]
+
+    assert not all(agg_taint(overlap_chip, pao)), \
+        "overlap form: local scatter-add must be collective-independent"
+    assert all(agg_taint(combined_chip, pac)), \
+        "combined form should depend on the collective everywhere"
 
 
 def test_backward_parity(ahat):
