@@ -102,6 +102,8 @@ def gat_forward_local(
     pa,                           # plan arrays dict (GAT_PLAN_FIELDS)
     activation: str = "none",
     final_activation: str = "none",
+    symmetric: bool = False,      # accepted for interface parity; attention
+                                  # weights are never symmetric, so unused
     axis_name: str = AXIS,
 ):
     """Per-chip forward: stacked GAT layers.

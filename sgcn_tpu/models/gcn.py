@@ -21,13 +21,22 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.pspmm import pspmm_overlap
+from ..ops.pspmm import pspmm_ell_sym, pspmm_overlap
 from ..parallel.mesh import AXIS
 from .activations import get_activation
 
-# plan arrays the GCN forward consumes (fullbatch ships exactly these)
-GCN_PLAN_FIELDS = ("send_idx", "halo_src", "ledge_dst", "ledge_src", "ledge_w",
-                   "hedge_dst", "hedge_src", "hedge_w")
+# plan arrays the GCN forward consumes (fullbatch ships exactly these).
+# Symmetric Â takes the ELL + symmetric-backward fast path; general Â the
+# split-COO overlap path whose backward is JAX's mechanical transpose.
+GCN_PLAN_FIELDS_SYM = ("send_idx", "halo_src", "ell_idx", "ell_w",
+                       "ltail_dst", "ltail_src", "ltail_w",
+                       "hedge_dst", "hedge_src", "hedge_w")
+GCN_PLAN_FIELDS_GEN = ("send_idx", "halo_src", "ledge_dst", "ledge_src",
+                       "ledge_w", "hedge_dst", "hedge_src", "hedge_w")
+
+
+def gcn_plan_fields(plan):
+    return GCN_PLAN_FIELDS_SYM if plan.symmetric else GCN_PLAN_FIELDS_GEN
 
 # minimum input width (f32 elements) for the project-before-aggregate layer
 # order to win: below this, random row gathers are HBM-access-bound, so
@@ -52,9 +61,10 @@ def init_gcn_params(rng: jax.Array, dims: list[tuple[int, int]]):
 def gcn_forward_local(
     params,
     h,                      # (B, f_in) local feature rows
-    pa,                     # plan arrays dict (GCN_PLAN_FIELDS)
+    pa,                     # plan arrays dict (gcn_plan_fields(plan))
     activation: str = "relu",
     final_activation: str = "none",
+    symmetric: bool = False,
     axis_name: str = AXIS,
 ):
     """Per-chip forward: L × (pspmm ⊗ dense matmul → activation) → (B, nout).
@@ -78,12 +88,19 @@ def gcn_forward_local(
     fact = get_activation(final_activation)
     nl = len(params)
 
-    def agg(x):
-        return pspmm_overlap(
-            x, pa["send_idx"], pa["halo_src"],
-            pa["ledge_dst"], pa["ledge_src"], pa["ledge_w"],
-            pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
-            axis_name=axis_name)
+    if symmetric:
+        def agg(x):
+            return pspmm_ell_sym(
+                x, pa["send_idx"], pa["halo_src"], pa["ell_idx"], pa["ell_w"],
+                pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
+                pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"], axis_name)
+    else:
+        def agg(x):
+            return pspmm_overlap(
+                x, pa["send_idx"], pa["halo_src"],
+                pa["ledge_dst"], pa["ledge_src"], pa["ledge_w"],
+                pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
+                axis_name=axis_name)
 
     for i, w in enumerate(params):
         if w.shape[1] < h.shape[1] and h.shape[1] >= PROJECT_FIRST_MIN_FIN:

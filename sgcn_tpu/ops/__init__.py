@@ -1,5 +1,5 @@
-from .pspmm import (halo_exchange, spmm_local, pspmm, pspmm_exchange,
-                    pspmm_overlap)
+from .pspmm import (halo_exchange, spmm_local, spmm_ell, pspmm,
+                    pspmm_exchange, pspmm_overlap, pspmm_ell_sym)
 
-__all__ = ["halo_exchange", "spmm_local", "pspmm", "pspmm_exchange",
-           "pspmm_overlap"]
+__all__ = ["halo_exchange", "spmm_local", "spmm_ell", "pspmm",
+           "pspmm_exchange", "pspmm_overlap", "pspmm_ell_sym"]

@@ -90,6 +90,31 @@ class CommPlan:
     lnnz: np.ndarray          # (k,) true local-src nnz
     hnnz: np.ndarray          # (k,) true halo-src nnz
 
+    # The local-src edges again, in fixed-width ELL layout: row i's first
+    # ``ell_k`` local in-edges sit in ``ell_idx[i]``/``ell_w[i]`` (src index /
+    # weight, zero-padded), overflow spills to a COO tail.  The hot SpMM
+    # becomes gather + DENSE weighted reduce over the width axis — no
+    # segment machinery, so XLA fuses the reduce into the gather consumer.
+    # Measured on v5e at ogbn-arxiv scale (n=169k, f=128): 16 ms vs 41 ms
+    # for the sorted-COO segment-sum, with the gather itself ~16 ms
+    # (pattern-independent per-row access cost; locality does not matter).
+    ell_k: int                # ELL width (0 disables)
+    tl: int                   # padded tail length
+    ell_idx: np.ndarray       # (k, B, ell_k) int32 local src, 0 on padding
+    ell_w: np.ndarray         # (k, B, ell_k) float32, 0 on padding
+    ltail_dst: np.ndarray     # (k, TL) int32
+    ltail_src: np.ndarray     # (k, TL) int32
+    ltail_w: np.ndarray       # (k, TL) float32, 0 on padding
+    ltail_nnz: np.ndarray     # (k,) true tail nnz
+
+    # True when the global adjacency is numerically symmetric (Â = Âᵀ) —
+    # verified at plan-build time.  Lets the SpMM backward reuse the forward
+    # structure (Âᵀg = Âg) instead of JAX's mechanical transpose, whose
+    # scatter-add is ~3.6× slower than the gather form on v5e.  The
+    # reference makes the same assumption (backward uses A, not Aᵀ —
+    # Parallel-GCN/main.c:374-404).
+    symmetric: bool
+
     # ------------------------------------------------------------------ stats
     @property
     def predicted_send_volume(self) -> np.ndarray:
@@ -191,6 +216,75 @@ def _split_edges(edge_dst, edge_src, edge_w, nnz, b,
                 hedge_dst=hd, hedge_src=hs, hedge_w=hw, lnnz=lnnz, hnnz=hnnz)
 
 
+def _build_ell(ledge_dst, ledge_src, ledge_w, lnnz, b,
+               ell_k: int | None = None, tl: int | None = None,
+               tail_frac: float = 0.02):
+    """Fixed-width ELL layout of the local-src edge lists + COO tail.
+
+    The width is the smallest multiple of 4 whose overflow tail holds at
+    most ``tail_frac`` of the local edges (capped at the max local degree):
+    wide enough that almost all edges take the fused gather+dense-reduce
+    path, narrow enough that padding gathers stay cheap on power-law
+    graphs whose hubs would otherwise blow the width up.
+    """
+    k = ledge_dst.shape[0]
+    degs = [np.bincount(ledge_dst[p, : int(lnnz[p])], minlength=b)
+            for p in range(k)]
+    alldeg = np.concatenate(degs) if k else np.zeros(1, np.int64)
+    maxdeg = int(alldeg.max()) if alldeg.size else 0
+    total = max(1, int(alldeg.sum()))
+    if ell_k is None:
+        ell_k = 4
+        while ell_k < maxdeg:
+            tail = int(np.maximum(alldeg - ell_k, 0).sum())
+            if tail <= tail_frac * total:
+                break
+            ell_k += 4
+        ell_k = min(ell_k, max(maxdeg, 1))
+    ell_idx = np.zeros((k, b, ell_k), dtype=np.int32)
+    ell_wv = np.zeros((k, b, ell_k), dtype=np.float32)
+    tails = []
+    for p in range(k):
+        cnt = int(lnnz[p])
+        d = ledge_dst[p, :cnt].astype(np.int64)
+        s0 = ledge_src[p, :cnt]
+        w = ledge_w[p, :cnt]
+        # position of each edge within its (sorted) dst run
+        starts = np.zeros(b + 1, dtype=np.int64)
+        np.cumsum(degs[p], out=starts[1:])
+        pos = np.arange(cnt) - starts[d]
+        main = pos < ell_k
+        ell_idx[p].reshape(-1)[d[main] * ell_k + pos[main]] = s0[main]
+        ell_wv[p].reshape(-1)[d[main] * ell_k + pos[main]] = w[main]
+        tails.append((d[~main].astype(np.int32), s0[~main], w[~main]))
+    ltail_nnz = np.array([len(t[0]) for t in tails], dtype=np.int64)
+    tl_nat = max(1, int(ltail_nnz.max()) if k else 1)
+    tl = tl_nat if tl is None else tl
+    if tl < tl_nat:
+        raise ValueError("tail envelope smaller than natural tail size")
+    ltail_dst = np.full((k, tl), b - 1, dtype=np.int32)
+    ltail_src = np.zeros((k, tl), dtype=np.int32)
+    ltail_w = np.zeros((k, tl), dtype=np.float32)
+    for p, (d, s0, w) in enumerate(tails):
+        ltail_dst[p, : len(d)] = d
+        ltail_src[p, : len(s0)] = s0
+        ltail_w[p, : len(w)] = w
+    return dict(ell_k=ell_k, tl=tl, ell_idx=ell_idx, ell_w=ell_wv,
+                ltail_dst=ltail_dst, ltail_src=ltail_src, ltail_w=ltail_w,
+                ltail_nnz=ltail_nnz)
+
+
+def _check_symmetric(a: sp.spmatrix) -> bool:
+    a = sp.csr_matrix(a)
+    d = (a - a.T).tocoo()
+    if d.nnz == 0 or d.data.size == 0:
+        return True
+    # relative tolerance: misclassifying an asymmetric matrix as symmetric
+    # would silently flip gradients to Â·g, so scale by the matrix magnitude
+    scale = max(float(np.abs(a.data).max()) if a.nnz else 0.0, 1e-30)
+    return float(np.abs(d.data).max()) <= 1e-6 * scale
+
+
 def relabel_plan(a: sp.spmatrix, partvec: np.ndarray, k: int,
                  pad_rows_to: int = 1) -> CommPlan:
     """Vertex relabeling + padding fields only — no halo/send construction.
@@ -222,11 +316,17 @@ def relabel_plan(a: sp.spmatrix, partvec: np.ndarray, k: int,
         hedge_dst=z((k, 1), np.int32), hedge_src=z((k, 1), np.int32),
         hedge_w=z((k, 1), np.float32),
         lnnz=z(k, np.int64), hnnz=z(k, np.int64),
+        ell_k=1, tl=1,
+        ell_idx=z((k, b, 1), np.int32), ell_w=z((k, b, 1), np.float32),
+        ltail_dst=z((k, 1), np.int32), ltail_src=z((k, 1), np.int32),
+        ltail_w=z((k, 1), np.float32), ltail_nnz=z(k, np.int64),
+        symmetric=_check_symmetric(a),
     )
 
 
 def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
-                  el: int | None = None, eh: int | None = None) -> CommPlan:
+                  el: int | None = None, eh: int | None = None,
+                  ell_k: int | None = None, tl: int | None = None) -> CommPlan:
     """Re-pad a plan to a larger (B, S, R, E) envelope.
 
     Lets many plans (one per mini-batch) share ONE compiled train step: the
@@ -239,11 +339,15 @@ def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
     """
     el = plan.el if el is None else el
     eh = plan.eh if eh is None else eh
-    if (b, s, r, e, el, eh) == (plan.b, plan.s, plan.r, plan.e,
-                                plan.el, plan.eh):
+    ell_k = plan.ell_k if ell_k is None else ell_k
+    tl = plan.tl if tl is None else tl
+    if (b, s, r, e, el, eh, ell_k, tl) == (
+            plan.b, plan.s, plan.r, plan.e, plan.el, plan.eh,
+            plan.ell_k, plan.tl):
         return plan
     if (b < plan.b or s < plan.s or r < plan.r or e < plan.e
-            or el < plan.el or eh < plan.eh):
+            or el < plan.el or eh < plan.eh or ell_k < plan.ell_k
+            or tl < plan.tl):
         raise ValueError("pad_comm_plan cannot shrink an envelope")
     k = plan.k
 
@@ -269,6 +373,9 @@ def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
     row_valid = np.zeros((k, b), dtype=np.float32)
     row_valid[:, : plan.b] = plan.row_valid
 
+    split = _split_edges(edge_dst, edge_src, edge_w, plan.nnz, b, el=el, eh=eh)
+    ell = _build_ell(split["ledge_dst"], split["ledge_src"], split["ledge_w"],
+                     split["lnnz"], b, ell_k=ell_k, tl=tl)
     return CommPlan(
         n=plan.n, k=k, b=b, s=s, r=r, e=e,
         owner=plan.owner, local_idx=plan.local_idx, part_sizes=plan.part_sizes,
@@ -276,7 +383,7 @@ def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
         halo_src=halo_src, halo_counts=plan.halo_counts.copy(),
         edge_dst=edge_dst, edge_src=edge_src, edge_w=edge_w,
         nnz=plan.nnz.copy(), row_valid=row_valid,
-        **_split_edges(edge_dst, edge_src, edge_w, plan.nnz, b, el=el, eh=eh),
+        symmetric=plan.symmetric, **split, **ell,
     )
 
 
@@ -378,6 +485,9 @@ def build_comm_plan(
         edge_src[p, :cnt] = csrc[srt]
         edge_w[p, :cnt] = vals[srt]
 
+    split = _split_edges(edge_dst, edge_src, edge_w, nnz, b)
+    ell = _build_ell(split["ledge_dst"], split["ledge_src"], split["ledge_w"],
+                     split["lnnz"], b)
     return CommPlan(
         n=n, k=k, b=b, s=s, r=r, e=e,
         owner=owner, local_idx=local_idx, part_sizes=part_sizes.astype(np.int64),
@@ -385,5 +495,5 @@ def build_comm_plan(
         halo_src=halo_src, halo_counts=halo_counts,
         edge_dst=edge_dst, edge_src=edge_src, edge_w=edge_w,
         nnz=nnz.astype(np.int64), row_valid=row_valid,
-        **_split_edges(edge_dst, edge_src, edge_w, nnz, b),
+        symmetric=_check_symmetric(a), **split, **ell,
     )

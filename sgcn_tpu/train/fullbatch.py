@@ -34,8 +34,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.gat import GAT_PLAN_FIELDS, gat_forward_local, init_gat_params
 from ..models.gcn import (
-    GCN_PLAN_FIELDS,
     gcn_forward_local,
+    gcn_plan_fields,
     init_gcn_params,
     masked_accuracy_local,
     masked_softmax_xent_local,
@@ -44,14 +44,15 @@ from ..parallel.mesh import AXIS, make_mesh_1d, replicate, shard_stacked
 from ..parallel.plan import CommPlan
 from ..utils.stats import CommStats
 
-# model registry: name → (param init, per-chip forward, plan fields shipped
+# model registry: name → (param init, per-chip forward, plan→fields shipped
 # to the device). GAT is the reference's PGAT capability (GPU/PGAT.py) on the
 # same trainer scaffold — like the reference, only the nn.Module differs
-# between PGCN.py and PGAT.py. GCN ships the split (overlap) edge lists, GAT
-# the combined ones its edge-softmax needs.
+# between PGCN.py and PGAT.py. GCN ships the ELL fast-path arrays for
+# symmetric Â (split COO otherwise); GAT the combined edge list its
+# edge-softmax needs.
 MODELS = {
-    "gcn": (init_gcn_params, gcn_forward_local, GCN_PLAN_FIELDS),
-    "gat": (init_gat_params, gat_forward_local, GAT_PLAN_FIELDS),
+    "gcn": (init_gcn_params, gcn_forward_local, gcn_plan_fields),
+    "gat": (init_gat_params, gat_forward_local, lambda plan: GAT_PLAN_FIELDS),
 }
 
 
@@ -130,7 +131,8 @@ class FullBatchTrainer:
         self.final_activation = final_activation
         self.compute_dtype = compute_dtype
         self.remat = remat
-        init_fn, self._forward_fn, self.plan_fields = MODELS[model]
+        init_fn, self._forward_fn, fields_fn = MODELS[model]
+        self.plan_fields = fields_fn(plan)
         self.model = model
         dims = list(zip([fin] + widths[:-1], widths))
         self.params = init_fn(jax.random.PRNGKey(seed), dims)
@@ -156,6 +158,7 @@ class FullBatchTrainer:
             params, h0, pa,
             activation=self.activation,
             final_activation=self.final_activation,
+            symmetric=self.plan.symmetric,
         )
         return out.astype("float32")
 
@@ -203,13 +206,19 @@ class FullBatchTrainer:
         return jax.jit(smapped)
 
     # ------------------------------------------------------------------- api
-    def step(self, data: TrainData) -> float:
+    def step(self, data: TrainData, sync: bool = True):
+        """One training step.  ``sync=True`` (default) blocks on the loss
+        scalar and returns a float — the per-epoch readback the reference's
+        loss print implies (``GPU/PGCN.py:223-224``).  ``sync=False`` returns
+        the on-device loss array so callers can pipeline many steps and pay
+        one host round-trip at the end (the tunneled dev chip has ~90 ms
+        round-trip latency that would otherwise swamp epoch timings)."""
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, self.pa, data.h0, data.labels,
             data.train_valid,
         )
         self.stats.count_step(nlayers=self.nlayers)
-        return float(loss)
+        return float(loss) if sync else loss
 
     def evaluate(self, data: TrainData) -> tuple[float, float]:
         loss, acc, _ = self._eval(

@@ -98,8 +98,14 @@ class MiniBatchTrainer:
             # batch misses some part entirely
             raw.append(build_comm_plan(sub, pv, k, pad_rows_to=pad_rows_to))
         env = tuple(max(getattr(p, f) for p in raw)
-                    for f in ("b", "s", "r", "e", "el", "eh"))
+                    for f in ("b", "s", "r", "e", "el", "eh", "ell_k", "tl"))
         self.plans = [pad_comm_plan(p, *env) for p in raw]
+        # one compiled step serves every batch, so the symmetric fast path is
+        # only safe if every batch plan is symmetric (sampled subgraphs of a
+        # symmetric graph are, but keep the guard exact)
+        if not all(p.symmetric for p in self.plans):
+            for p in self.plans:
+                p.symmetric = False
 
         # one inner trainer = one compiled step for every batch
         self.inner = FullBatchTrainer(

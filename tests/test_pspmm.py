@@ -14,7 +14,8 @@ from sgcn_tpu.ops import pspmm_exchange, pspmm_overlap
 from sgcn_tpu.parallel import build_comm_plan, make_mesh_1d, shard_stacked
 from sgcn_tpu.partition import balanced_random_partition, random_partition
 
-from sgcn_tpu.models.gcn import GCN_PLAN_FIELDS as OVERLAP_FIELDS
+from sgcn_tpu.models.gcn import GCN_PLAN_FIELDS_GEN as OVERLAP_FIELDS
+from sgcn_tpu.models.gcn import GCN_PLAN_FIELDS_SYM as SYM_FIELDS
 
 
 def _overlap_args(pa):
@@ -119,6 +120,102 @@ def test_overlap_backward_parity(ahat):
                                out_specs=P("v")))
     got = plan.gather_rows(np.asarray(fn(pa, hb, wb)))
     np.testing.assert_allclose(got, ahat.T @ wgt, rtol=1e-4, atol=1e-5)
+
+
+def _sym_args(pa):
+    return tuple(pa[f] for f in SYM_FIELDS)
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_ell_sym_forward_parity(ahat, k):
+    """The ELL + symmetric-backward fast path must also compute dense Â·H."""
+    from sgcn_tpu.ops import pspmm_ell_sym
+    n = ahat.shape[0]
+    f = 5
+    plan = build_comm_plan(ahat, balanced_random_partition(n, k, seed=11), k)
+    assert plan.symmetric            # Â of an undirected graph
+    # ELL invariants: main + tail covers exactly the local edges
+    ell_edges = (plan.ell_w != 0).sum() + plan.ltail_nnz.sum()
+    assert ell_edges == (plan.ledge_w != 0).sum()
+    mesh = make_mesh_1d(k)
+    h = np.random.default_rng(4).standard_normal((n, f)).astype(np.float32)
+    hb = shard_stacked(mesh, plan.scatter_rows(h))
+    pa = shard_stacked(mesh, {f_: getattr(plan, f_) for f_ in SYM_FIELDS})
+
+    def per_chip(pa, h):
+        pa = jax.tree.map(lambda x: x[0], pa)
+        return pspmm_ell_sym(h[0], *_sym_args(pa))[None]
+
+    fn = jax.jit(jax.shard_map(per_chip, mesh=mesh,
+                               in_specs=(P("v"), P("v")), out_specs=P("v")))
+    got = plan.gather_rows(np.asarray(fn(pa, hb)))
+    np.testing.assert_allclose(got, ahat @ h, rtol=1e-4, atol=1e-5)
+
+
+def test_ell_sym_backward_parity(ahat):
+    """The symmetric custom VJP (bwd = forward applied to g) must equal
+    Âᵀ·w = Â·w, including the exchange in the backward."""
+    from sgcn_tpu.ops import pspmm_ell_sym
+    n = ahat.shape[0]
+    k = 4
+    f = 3
+    plan = build_comm_plan(ahat, balanced_random_partition(n, k, seed=13), k)
+    mesh = make_mesh_1d(k)
+    rng = np.random.default_rng(7)
+    h = rng.standard_normal((n, f)).astype(np.float32)
+    wgt = rng.standard_normal((n, f)).astype(np.float32)
+    pa = shard_stacked(mesh, {f_: getattr(plan, f_) for f_ in SYM_FIELDS})
+    hb = shard_stacked(mesh, plan.scatter_rows(h))
+    wb = shard_stacked(mesh, plan.scatter_rows(wgt))
+
+    def per_chip(pa, h, w):
+        pa = jax.tree.map(lambda x: x[0], pa)
+
+        def obj(hl):
+            out = pspmm_ell_sym(hl, *_sym_args(pa))
+            return jax.lax.psum(jnp.sum(out * w[0]), "v")
+
+        return jax.grad(obj)(h[0])[None]
+
+    fn = jax.jit(jax.shard_map(per_chip, mesh=mesh,
+                               in_specs=(P("v"), P("v"), P("v")),
+                               out_specs=P("v")))
+    got = plan.gather_rows(np.asarray(fn(pa, hb, wb)))
+    np.testing.assert_allclose(got, ahat.T @ wgt, rtol=1e-4, atol=1e-5)
+
+
+def test_directed_graph_detected_not_symmetric():
+    """A directed adjacency must opt out of the symmetric fast path, and the
+    general path's mechanical transpose must stay exact (Âᵀ ≠ Â here)."""
+    import scipy.sparse as sp
+    rng = np.random.default_rng(3)
+    n, k, f = 40, 4, 3
+    dense = (rng.random((n, n)) < 0.2).astype(np.float32)
+    np.fill_diagonal(dense, 0)
+    a = sp.csr_matrix(dense)                  # deliberately asymmetric
+    plan = build_comm_plan(a, balanced_random_partition(n, k, seed=5), k)
+    assert not plan.symmetric
+    mesh = make_mesh_1d(k)
+    h = rng.standard_normal((n, f)).astype(np.float32)
+    wgt = rng.standard_normal((n, f)).astype(np.float32)
+    pa = shard_stacked(mesh, {f_: getattr(plan, f_) for f_ in OVERLAP_FIELDS})
+    hb = shard_stacked(mesh, plan.scatter_rows(h))
+    wb = shard_stacked(mesh, plan.scatter_rows(wgt))
+
+    def per_chip(pa, h, w):
+        pa = jax.tree.map(lambda x: x[0], pa)
+
+        def obj(hl):
+            out = pspmm_overlap(hl, *_overlap_args(pa))
+            return jax.lax.psum(jnp.sum(out * w[0]), "v")
+
+        return jax.grad(obj)(h[0])[None]
+
+    fn = jax.jit(jax.shard_map(per_chip, mesh=mesh,
+                               in_specs=(P("v"), P("v"), P("v")),
+                               out_specs=P("v")))
+    got = plan.gather_rows(np.asarray(fn(pa, hb, wb)))
+    np.testing.assert_allclose(got, a.T @ wgt, rtol=1e-4, atol=1e-5)
 
 
 def _collective_taint(jaxpr):
