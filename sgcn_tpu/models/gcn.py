@@ -129,6 +129,34 @@ def masked_softmax_xent_local(logits, labels, valid, axis_name: str = AXIS):
     return total / jnp.maximum(count, 1.0)
 
 
+def masked_sigmoid_bce_local(logits, labels, valid, axis_name: str = AXIS):
+    """Global mean elementwise sigmoid+BCE against one-hot targets — the MPI
+    trainer's loss flavor (``Parallel-GCN/main.c:70-90``).
+
+    The C stack's backward chain ``H=(H−Y)/[H(1−H)]; G=H⊙σ'(Z)`` collapses
+    to exactly ``σ(z)−y`` (the BCE-with-logits gradient), so training under
+    this loss reproduces grbgcn's update rule; the stable softplus form
+    avoids materializing σ(z) in the loss itself.
+    """
+    y = jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype)
+    bce = (jnp.maximum(logits, 0) - logits * y
+           + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    local = jnp.sum(bce * valid[:, None])
+    total = lax.psum(local, axis_name)
+    count = lax.psum(jnp.sum(valid), axis_name)
+    return total / jnp.maximum(count, 1.0)
+
+
+def masked_err_local(logits, labels, valid, axis_name: str = AXIS):
+    """The MPI stack's printed ``err``: Σ −y·log σ(z) over valid rows, summed
+    (not averaged) across ranks — ``T = −Y⊙log H; err = reduce(T)``
+    (``Parallel-GCN/main.c:318-323``)."""
+    logp = jax.nn.log_sigmoid(logits)
+    picked = jnp.take_along_axis(
+        logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return lax.psum(-jnp.sum(picked * valid), axis_name)
+
+
 def masked_accuracy_local(logits, labels, valid, axis_name: str = AXIS):
     """Global accuracy over valid rows (every chip gets the same scalar)."""
     pred = jnp.argmax(logits, axis=-1)

@@ -39,6 +39,17 @@ def main() -> None:
     p.add_argument("-n", "--batch-size", type=int, default=None,
                    help="enable the mini-batch trainer")
     p.add_argument("--model", default="gcn", choices=["gcn", "gat"])
+    p.add_argument("--activation", default=None,
+                   choices=["relu", "sigmoid", "elu", "none"],
+                   help="inter-layer activation; defaults to relu for gcn "
+                        "(GPU/PGCN.py:147) and none for gat — the reference "
+                        "stacks bare PGAT modules with no nonlinearity "
+                        "between them (GPU/PGAT.py:202-213)")
+    p.add_argument("--loss", default="xent", choices=["xent", "bce"],
+                   help="xent = torch-stack log-softmax+NLL "
+                        "(GPU/PGCN.py:204-205); bce = the MPI stack's "
+                        "sigmoid+BCE with the reported `err` metric "
+                        "(Parallel-GCN/main.c:70-90,318-335)")
     p.add_argument("--dtype", default=None, choices=["bfloat16"],
                    help="mixed-precision compute (f32 master params)")
     p.add_argument("--epochs", type=int, default=4)
@@ -107,18 +118,22 @@ def main() -> None:
 
     hidden = args.hidden or f
     widths = [hidden] * (args.nlayers - 1) + [nclasses]
+    # PGAT stacks bare modules: no inter-layer nonlinearity unless asked
+    activation = args.activation or ("none" if args.model == "gat" else "relu")
 
     if args.batch_size is not None:
         tr = MiniBatchTrainer(a, pv, k, fin=f, widths=widths,
                               batch_size=args.batch_size, lr=args.lr,
-                              model=args.model, seed=args.seed,
+                              model=args.model, loss=args.loss,
+                              activation=activation, seed=args.seed,
                               compute_dtype=args.dtype)
         report = tr.fit(feats, labels, epochs=args.epochs,
                         warmup=args.warmup)
     else:
         plan = build_comm_plan(a, pv, k)
         tr = FullBatchTrainer(plan, fin=f, widths=widths, lr=args.lr,
-                              model=args.model, seed=args.seed,
+                              model=args.model, loss=args.loss,
+                              activation=activation, seed=args.seed,
                               compute_dtype=args.dtype)
         data = make_train_data(plan, feats, labels)
         report = tr.fit(data, epochs=args.epochs, warmup=args.warmup)
@@ -126,6 +141,8 @@ def main() -> None:
     # rank-0-style end-of-run line (GPU/PGCN.py:226-238)
     report["backend"] = args.backend
     report["model"] = args.model
+    report["activation"] = activation
+    report["loss"] = args.loss
     report.pop("loss_history", None)
     if ctx.is_coordinator:
         print(json.dumps(report), flush=True)

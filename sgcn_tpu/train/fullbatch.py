@@ -38,6 +38,8 @@ from ..models.gcn import (
     gcn_plan_fields,
     init_gcn_params,
     masked_accuracy_local,
+    masked_err_local,
+    masked_sigmoid_bce_local,
     masked_softmax_xent_local,
 )
 from ..parallel.mesh import AXIS, make_mesh_1d, replicate, shard_stacked
@@ -53,6 +55,14 @@ from ..utils.stats import CommStats
 MODELS = {
     "gcn": (init_gcn_params, gcn_forward_local, gcn_plan_fields),
     "gat": (init_gat_params, gat_forward_local, lambda plan: GAT_PLAN_FIELDS),
+}
+
+# loss registry: 'xent' is the torch stack's log-softmax+NLL
+# (GPU/PGCN.py:204-205), 'bce' the MPI stack's sigmoid+BCE
+# (Parallel-GCN/main.c:70-90) whose reported metric is `err`.
+LOSSES = {
+    "xent": masked_softmax_xent_local,
+    "bce": masked_sigmoid_bce_local,
 }
 
 
@@ -112,6 +122,7 @@ class FullBatchTrainer:
         optimizer: optax.GradientTransformation | None = None,
         seed: int = 0,
         model: str = "gcn",
+        loss: str = "xent",
         compute_dtype: str | None = None,
         remat: bool = False,
     ):
@@ -134,12 +145,15 @@ class FullBatchTrainer:
         init_fn, self._forward_fn, fields_fn = MODELS[model]
         self.plan_fields = fields_fn(plan)
         self.model = model
+        self.loss_name = loss
+        self._loss_fn = LOSSES[loss]
         dims = list(zip([fin] + widths[:-1], widths))
         self.params = init_fn(jax.random.PRNGKey(seed), dims)
         self.opt = optimizer if optimizer is not None else optax.adam(lr)
         self.opt_state = self.opt.init(self.params)
         self.params = replicate(self.mesh, self.params)
         self.opt_state = replicate(self.mesh, self.opt_state)
+        self.last_err = None
         self.pa = shard_stacked(self.mesh, _plan_arrays(plan, self.plan_fields))
         self.stats = CommStats.from_plan(plan)
         self._step = self._build_step()
@@ -171,21 +185,25 @@ class FullBatchTrainer:
 
             def loss_fn(ps):
                 logits = fwd(ps, pa, h0)
-                return masked_softmax_xent_local(logits, labels, valid)
+                loss = self._loss_fn(logits, labels, valid)
+                err = (masked_err_local(logits, labels, valid)
+                       if self.loss_name == "bce" else loss)
+                return loss, err
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            (loss, err), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params)
             # dense weight-grad allreduce — GPU/PGCN.py:150-154 /
             # Parallel-GCN/main.c:422-425 (psum of local partials = full grad)
             grads = jax.tree.map(lambda g: lax.psum(g, AXIS), grads)
             updates, opt_state = self.opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
+            return params, opt_state, loss, err
 
         smapped = jax.shard_map(
             per_chip,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
-            out_specs=(P(), P(), P()),
+            out_specs=(P(), P(), P(), P()),
         )
         return jax.jit(smapped, donate_argnums=(0, 1))
 
@@ -213,10 +231,11 @@ class FullBatchTrainer:
         the on-device loss array so callers can pipeline many steps and pay
         one host round-trip at the end (the tunneled dev chip has ~90 ms
         round-trip latency that would otherwise swamp epoch timings)."""
-        self.params, self.opt_state, loss = self._step(
+        self.params, self.opt_state, loss, err = self._step(
             self.params, self.opt_state, self.pa, data.h0, data.labels,
             data.train_valid,
         )
+        self.last_err = err   # the MPI stack's `err` metric under loss='bce'
         self.stats.count_step(nlayers=self.nlayers)
         return float(loss) if sync else loss
 
@@ -268,4 +287,7 @@ class FullBatchTrainer:
             epoch_s=elapsed / max(epochs, 1),
             loss_history=history,
         )
+        if self.loss_name == "bce":
+            # rank-0 err line of the MPI stack (Parallel-GCN/main.c:322-323)
+            report["err"] = float(self.last_err)
         return report

@@ -77,6 +77,7 @@ class MiniBatchTrainer:
         lr: float = 0.01,
         activation: str = "relu",
         model: str = "gcn",
+        loss: str = "xent",
         optimizer: optax.GradientTransformation | None = None,
         seed: int = 0,
         pad_rows_to: int = 8,
@@ -110,7 +111,8 @@ class MiniBatchTrainer:
         # one inner trainer = one compiled step for every batch
         self.inner = FullBatchTrainer(
             self.plans[0], fin, widths, mesh=self.mesh, lr=lr,
-            activation=activation, model=model, optimizer=optimizer, seed=seed,
+            activation=activation, model=model, loss=loss,
+            optimizer=optimizer, seed=seed,
             compute_dtype=compute_dtype)
         self.total_exchanged_rows = 0
         self.nlayers = len(widths)
@@ -136,7 +138,7 @@ class MiniBatchTrainer:
     # ------------------------------------------------------------------- api
     def step(self, batch: Batch) -> float:
         tr = self.inner
-        tr.params, tr.opt_state, loss = tr._step(
+        tr.params, tr.opt_state, loss, tr.last_err = tr._step(
             tr.params, tr.opt_state, batch.pa, batch.data.h0,
             batch.data.labels, batch.data.train_valid)
         self.total_exchanged_rows += 2 * self.nlayers * int(

@@ -86,6 +86,40 @@ def test_shp_to_minibatch_train(pipeline):
     assert report["nbatches"] > 0
 
 
+def test_train_cli_gat_default_activation_none(pipeline):
+    """PGAT semantic fidelity: the reference stacks bare PGAT modules with no
+    inter-layer nonlinearity (GPU/PGAT.py:202-213), so --model gat must not
+    silently apply relu; --activation overrides."""
+    d = pipeline
+    r = run_cli(["sgcn_tpu.train", "-a", str(d / "g.A.mtx"),
+                 "-p", str(d / "g.A.mtx.4.hp"), "-b", "cpu", "-s", "4",
+                 "-l", "2", "-f", "6", "--model", "gat", "--epochs", "1"])
+    assert r.returncode == 0, r.stderr
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["activation"] == "none"
+    r = run_cli(["sgcn_tpu.train", "-a", str(d / "g.A.mtx"),
+                 "-p", str(d / "g.A.mtx.4.hp"), "-b", "cpu", "-s", "4",
+                 "-l", "2", "-f", "6", "--model", "gat", "--epochs", "1",
+                 "--activation", "elu"])
+    assert r.returncode == 0, r.stderr
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["activation"] == "elu"
+
+
+def test_train_cli_bce_loss_reports_err(pipeline):
+    """The MPI stack's loss flavor: sigmoid+BCE training with the `err`
+    metric in the rank-0 report (Parallel-GCN/main.c:70-90,318-335)."""
+    d = pipeline
+    r = run_cli(["sgcn_tpu.train", "-a", str(d / "g.A.mtx"),
+                 "-p", str(d / "g.A.mtx.4.hp"), "-b", "cpu", "-s", "4",
+                 "-l", "2", "-f", "6", "--loss", "bce",
+                 "--activation", "sigmoid", "--epochs", "2"])
+    assert r.returncode == 0, r.stderr
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["loss"] == "bce"
+    assert report["err"] > 0
+
+
 def test_train_cli_rejects_bad_partvec(pipeline):
     d = pipeline
     (d / "bad.part").write_text("0 1 2\n")
