@@ -56,6 +56,12 @@ def bench_jax(ahat, feats, labels, widths, epochs: int):
     data = make_train_data(plan, feats, labels)
     data = type(data)(**shard_stacked(mesh, vars(data)))
     trainer.step(data)                            # warm-up (compile) + sync
+    # step(sync=True) blocks only on the loss scalar; force the warm-up Adam
+    # update fully retired before timing (block the whole param tree, then a
+    # scalar readback — block_until_ready alone can return early through the
+    # tunnel on shard_map outputs)
+    jax.block_until_ready(trainer.params)
+    float(np.asarray(jax.tree.leaves(trainer.params)[-1]).ravel()[0])
     # median of per-round timings: the tunneled chip is shared, single runs
     # can be 2x noisy. Steps within a round are dispatched asynchronously and
     # the round blocks once on the last loss scalar — one host round-trip per
@@ -72,6 +78,58 @@ def bench_jax(ahat, feats, labels, widths, epochs: int):
         if not np.isfinite(loss_val):
             raise RuntimeError(f"non-finite loss {loss_val}")
     return statistics.median(rounds), part_metrics
+
+
+def bench_dense_equiv(n: int, fin: int, widths, epochs: int) -> float:
+    """Dense-matmul roofline epoch at identical shapes — the honest
+    single-chip yardstick next to the torch-CPU comparison (VERDICT r2).
+
+    Same layer stack, loss, backward, and Adam update, but each sparse
+    aggregation Â·H is replaced by an (n,f)×(f,f) dense matmul over the same
+    activation rows.  That stand-in does strictly MORE FLOPs than the SpMM
+    (2·n·f² vs 2·nnz·f, ~9× at ogbn-arxiv shape) while mapping perfectly to
+    the MXU, so ``epoch_s / dense_equiv_s`` isolates how much the gather-bound
+    sparse path costs relative to a compiler-friendly dense epoch."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    key = jax.random.PRNGKey(0)
+    dims = list(zip([fin] + widths[:-1], widths))
+    keys = jax.random.split(key, len(dims) + 1)
+    params = [jax.random.normal(k, d, jnp.float32) * 0.05
+              for k, d in zip(keys[:-1], dims)]
+    mixers = [jnp.eye(i, dtype=jnp.float32) for i, _ in dims]
+    h0 = jax.random.normal(keys[-1], (n, fin), jnp.float32)
+    labels = jnp.zeros((n,), jnp.int32)
+    opt = optax.adam(0.01)
+    opt_state = opt.init(params)
+
+    def loss_fn(ps):
+        h = h0
+        for i, (w, m) in enumerate(zip(ps, mixers)):
+            z = (h @ m) @ w
+            h = z if i == len(ps) - 1 else jax.nn.relu(z)
+        logp = jax.nn.log_softmax(h)
+        return -logp[jnp.arange(n), labels].mean()
+
+    @jax.jit
+    def step(ps, st):
+        loss, g = jax.value_and_grad(loss_fn)(ps)
+        up, st = opt.update(g, st, ps)
+        return optax.apply_updates(ps, up), st, loss
+
+    params, opt_state, loss = step(params, opt_state)   # warm-up (compile)
+    jax.block_until_ready(params)
+    float(loss)
+    rounds = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            params, opt_state, loss = step(params, opt_state)
+        float(loss)                               # block once per round
+        rounds.append((time.perf_counter() - t0) / epochs)
+    return statistics.median(rounds)
 
 
 def bench_torch_reference(ahat, feats, labels, widths, epochs: int) -> float:
@@ -129,17 +187,30 @@ def main() -> None:
     widths = [args.hidden] * (args.layers - 1) + [args.classes]
 
     epoch_s, part_metrics = bench_jax(ahat, feats, labels, widths, args.epochs)
+    # two honest yardsticks (VERDICT r2 weak #2/#6): the reference-style torch
+    # CPU stack (kept, as vs_torch_cpu) and the dense-matmul roofline epoch at
+    # identical shapes (epoch_vs_dense >= 1; 1.0 = sparse path at MXU parity).
+    # The dense epoch is single-device, so the ratio is only meaningful for
+    # the single-chip run — on a multi-chip mesh it would conflate parallel
+    # speedup with gather efficiency; emit null there.
+    import jax as _jax
+    single = len(_jax.devices()) == 1
+    dense_s = bench_dense_equiv(args.n, args.f, widths, args.epochs) \
+        if single else None
     if args.skip_torch:
-        vs = 1.0
+        vs = None                               # never fabricate parity
     else:
         ref_s = bench_torch_reference(ahat, feats, labels, widths,
                                       max(2, args.epochs // 2))
-        vs = ref_s / epoch_s
+        vs = round(ref_s / epoch_s, 3)
     print(json.dumps({
         "metric": "fullbatch_gcn_epoch_time",
         "value": round(epoch_s, 6),
         "unit": "s",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": vs,
+        "vs_torch_cpu": vs,
+        "dense_equiv_s": round(dense_s, 6) if dense_s else None,
+        "epoch_vs_dense": round(epoch_s / dense_s, 3) if dense_s else None,
         **part_metrics,
     }))
 

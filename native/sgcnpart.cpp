@@ -548,7 +548,11 @@ struct Km1Refiner {
     int since_best = 0;
     const int drift =                               // hill-climb tolerance
         std::max(30, std::min(h.ncells / 16, 256));
-    while (!heap.empty() && since_best < drift &&
+    // Stale-entry revalidation pops don't advance since_best; cap total pops
+    // so adversarial churn (many requeues between applies) stays bounded.
+    size_t pops = 0;
+    const size_t pop_cap = 16u * (size_t)h.ncells + 1024;
+    while (!heap.empty() && since_best < drift && pops++ < pop_cap &&
            moves.size() < (size_t)h.ncells) {
       auto [g, v, t] = heap.top(); heap.pop();
       if (locked[v]) continue;

@@ -211,7 +211,11 @@ class FullBatchTrainer:
         def per_chip(params, pa, h0, labels, valid):
             pa, h0, labels, valid = _unblock((pa, h0, labels, valid))
             logits = self._forward(params, pa, h0)
-            loss = masked_softmax_xent_local(logits, labels, valid)
+            # eval loss uses the SAME objective as training, so train/eval
+            # losses are comparable under --loss bce too (the MPI stack
+            # reports the one flavor it trains with,
+            # Parallel-GCN/main.c:318-335)
+            loss = self._loss_fn(logits, labels, valid)
             acc = masked_accuracy_local(logits, labels, valid)
             return loss, acc, logits[None]
 

@@ -30,6 +30,7 @@ import scipy.sparse as sp
 
 from ..parallel.mesh import make_mesh_1d, shard_stacked
 from ..parallel.plan import build_comm_plan, pad_comm_plan
+from ..utils.stats import CommStats
 from .fullbatch import (FullBatchTrainer, TrainData, _plan_arrays,
                         make_train_data)
 
@@ -59,6 +60,7 @@ class Batch:
     plan: object          # padded CommPlan over the batch subgraph
     pa: dict              # sharded plan arrays
     data: TrainData       # sharded per-chip batch blocks
+    stats: CommStats      # per-batch-plan counters (own send/recv volumes)
 
 
 class MiniBatchTrainer:
@@ -114,7 +116,6 @@ class MiniBatchTrainer:
             activation=activation, model=model, loss=loss,
             optimizer=optimizer, seed=seed,
             compute_dtype=compute_dtype)
-        self.total_exchanged_rows = 0
         self.nlayers = len(widths)
         self._fullgraph_eval = None   # built lazily, cached across calls
 
@@ -132,6 +133,7 @@ class MiniBatchTrainer:
                 pa=shard_stacked(self.mesh,
                                  _plan_arrays(plan, self.inner.plan_fields)),
                 data=TrainData(**shard_stacked(self.mesh, vars(data))),
+                stats=CommStats.from_plan(plan),
             ))
         return out
 
@@ -141,8 +143,11 @@ class MiniBatchTrainer:
         tr.params, tr.opt_state, loss, tr.last_err = tr._step(
             tr.params, tr.opt_state, batch.pa, batch.data.h0,
             batch.data.labels, batch.data.train_valid)
-        self.total_exchanged_rows += 2 * self.nlayers * int(
-            batch.plan.predicted_send_volume.sum())
+        # per-batch counters advance exactly like the full-batch trainer's —
+        # the reference's mini-batch code shares one counter dict across
+        # batches (GPU/PGCN-Mini-batch.py), so end-of-run stats carry the
+        # same 8-number vocabulary
+        batch.stats.count_step(nlayers=self.nlayers)
         return float(loss)
 
     def fit(self, features: np.ndarray, labels: np.ndarray,
@@ -166,14 +171,18 @@ class MiniBatchTrainer:
                 print(f"epoch {ep}: batch-avg loss {ep_loss:.6f}", flush=True)
         jax.block_until_ready(self.inner.params)
         elapsed = time.perf_counter() - t0
-        return {
-            "epochs": epochs,
-            "nbatches": len(batches),
-            "elapsed_s": elapsed,
-            "epoch_s": elapsed / max(epochs, 1),
-            "loss_history": history,
-            "total_exchanged_rows": self.total_exchanged_rows,
-        }
+        report = CommStats.merged_report([b.stats for b in batches])
+        report.update(
+            epochs=epochs,
+            nbatches=len(batches),
+            elapsed_s=elapsed,
+            epoch_s=elapsed / max(epochs, 1),
+            loss_history=history,
+            # legacy alias of total_send_volume (rows shipped across all
+            # exchanges) — derived, not independently counted
+            total_exchanged_rows=report["total_send_volume"],
+        )
+        return report
 
     # full-graph evaluation path (accuracy-parity experiments evaluate on the
     # whole graph after mini-batch training — GPU/PGCN-Accuracy.py role)
@@ -184,7 +193,7 @@ class MiniBatchTrainer:
             self._fullgraph_eval = (plan, FullBatchTrainer(
                 plan, features.shape[1], self._widths_from_params(),
                 mesh=self.mesh, activation=self.inner.activation,
-                model=self.inner.model,
+                model=self.inner.model, loss=self.inner.loss_name,
                 compute_dtype=self.inner.compute_dtype))
         plan, tr = self._fullgraph_eval
         tr.params = self.inner.params

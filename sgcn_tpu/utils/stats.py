@@ -48,19 +48,38 @@ class CommStats:
     def count_forward(self, nlayers: int) -> None:
         self.exchanges += nlayers
 
-    def report(self) -> dict:
-        sv = self.send_volume_per_exchange * self.exchanges
-        sm = self.send_msgs_per_exchange * self.exchanges
-        rv = self.recv_volume_per_exchange * self.exchanges
-        rm = self.recv_msgs_per_exchange * self.exchanges
+    def cumulative(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Per-rank cumulative (send_vol, send_msgs, recv_vol, recv_msgs)."""
+        return (
+            self.send_volume_per_exchange * self.exchanges,
+            self.send_msgs_per_exchange * self.exchanges,
+            self.recv_volume_per_exchange * self.exchanges,
+            self.recv_msgs_per_exchange * self.exchanges,
+        )
+
+    @staticmethod
+    def report_from_cumulative(sv, sm, rv, rm) -> dict:
         # the reference's 8-number line: SUM and MAX over ranks of each counter
         return {
             "total_send_volume": int(sv.sum()),
-            "max_send_volume": int(sv.max()) if self.k else 0,
+            "max_send_volume": int(sv.max()) if sv.size else 0,
             "total_send_msgs": int(sm.sum()),
-            "max_send_msgs": int(sm.max()) if self.k else 0,
+            "max_send_msgs": int(sm.max()) if sm.size else 0,
             "total_recv_volume": int(rv.sum()),
-            "max_recv_volume": int(rv.max()) if self.k else 0,
+            "max_recv_volume": int(rv.max()) if rv.size else 0,
             "total_recv_msgs": int(rm.sum()),
-            "max_recv_msgs": int(rm.max()) if self.k else 0,
+            "max_recv_msgs": int(rm.max()) if rm.size else 0,
         }
+
+    def report(self) -> dict:
+        return self.report_from_cumulative(*self.cumulative())
+
+    @staticmethod
+    def merged_report(stats_list) -> dict:
+        """Aggregate many counters (e.g. one per mini-batch plan) the way one
+        rank accumulates across batches in the reference: per-rank sums first,
+        SUM/MAX over ranks second (``GPU/PGCN-Mini-batch.py`` shares the
+        counter dict across batches; ``Parallel-GCN/main.c:506-524``)."""
+        parts = [s.cumulative() for s in stats_list]
+        sums = [np.sum([p[i] for p in parts], axis=0) for i in range(4)]
+        return CommStats.report_from_cumulative(*sums)

@@ -75,3 +75,27 @@ def test_distributed_bce_training_learns(ahat):
     assert last < first
     assert err_last < err_first
     assert err_first > 0
+
+
+def test_eval_loss_honors_bce_flavor(ahat):
+    """evaluate() must report the TRAINED objective: under --loss bce the
+    eval loss is sigmoid+BCE, not softmax xent (VERDICT r2 weak #5)."""
+    n = ahat.shape[0]
+    k = 4
+    rng = np.random.default_rng(3)
+    feats = rng.standard_normal((n, 8)).astype(np.float32)
+    labels = (np.arange(n) % 3).astype(np.int32)
+    plan = build_comm_plan(ahat, balanced_random_partition(n, k, seed=1), k)
+    mesh = make_mesh_1d(k)
+    tr = FullBatchTrainer(plan, fin=8, widths=[16, 3], mesh=mesh,
+                          activation="sigmoid", loss="bce", lr=0.05)
+    data = make_train_data(plan, feats, labels)
+    sdata = type(data)(**shard_stacked(mesh, vars(data)))
+    loss_eval, _ = tr.evaluate(sdata)
+    # oracle: mean elementwise BCE over all rows from the global logits
+    logits = tr.predict(sdata)
+    y = np.eye(3, dtype=np.float32)[labels]
+    bce = (np.maximum(logits, 0) - logits * y
+           + np.log1p(np.exp(-np.abs(logits))))
+    want = bce.sum() / n
+    np.testing.assert_allclose(loss_eval, want, rtol=1e-4)
