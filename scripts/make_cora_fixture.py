@@ -1,0 +1,41 @@
+"""Regenerate the committed cora-format fixture under tests/fixtures/.
+
+The reference's accuracy experiment runs on the real cora download
+(GPU/PGCN-Accuracy.py, README.md:110); zero egress means the repo instead
+commits a deterministic generative stand-in with cora's exact format (sparse
+binary bag-of-words features, 7 classes, citation-style graph) emitted in
+BOTH real-data ingestion layouts:
+
+  * ``cora_like.npz``          — planetoid/ogbn-style snapshot (--npz);
+  * ``cora_like.{A,H,Y}.mtx``  — the reference's MatrixMarket family
+                                  (-a/--features-mtx/--labels-mtx);
+  * ``cora_like.4.hp``         — native hypergraph partitioner output (-p).
+
+Run from the repo root: ``python scripts/make_cora_fixture.py``.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sgcn_tpu.io.datasets import cora_like, save_fixture, save_npz_dataset
+from sgcn_tpu.partition.emit import write_partvec
+from sgcn_tpu.partition.native import partition_hypergraph_colnet
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "fixtures")
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    a, feats, labels = cora_like(n=600, nclasses=7, vocab=64, seed=7)
+    prefix = os.path.join(OUT, "cora_like")
+    save_npz_dataset(prefix + ".npz", a, feats, labels)
+    save_fixture(prefix, a, labels=labels, features=feats)
+    pv, _km1 = partition_hypergraph_colnet(a, k=4, seed=1)
+    write_partvec(prefix + ".4.hp", pv)
+    print("wrote fixture family under", OUT)
+
+
+if __name__ == "__main__":
+    main()

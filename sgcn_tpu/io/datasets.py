@@ -11,8 +11,17 @@ sparse.tamu.edu / OGB as ``.mtx`` (``README.md:11``).  Zero-egress here, so:
   * ``planted_partition()`` — learnable community graphs for accuracy tests;
   * ``er_graph()`` — ogbn-scale synthetic graphs for benchmarking (the shape
     stand-in for ogbn-arxiv/products when the real download is unavailable);
-  * ``save_fixture()`` — emit any of them as ``.mtx`` (+ labels) for CLI
-    round-trip tests.
+  * ``cora_like()`` — citation-style graph with sparse binary bag-of-words
+    features in cora's exact format (the reference's accuracy experiment runs
+    on cora, ``GPU/PGCN-Accuracy.py`` / ``README.md:110``);
+  * ``load_npz_dataset()`` / ``save_npz_dataset()`` — the on-disk ``.npz``
+    layout real planetoid/ogbn snapshots ship in (``adj_*`` CSR triplets +
+    ``attr_*`` + ``labels``), so a user with a downloaded ``cora.npz`` /
+    ``ogbn-arxiv`` snapshot feeds it straight to the trainers;
+  * ``planetoid_split()`` — the fixed per-class train / held-out test split
+    semantics of the planetoid benchmarks;
+  * ``save_fixture()`` — emit any of them as the ``.mtx`` family
+    (``A/H/Y``) the reference's pipeline communicates through.
 """
 
 from __future__ import annotations
@@ -83,17 +92,137 @@ def er_graph(n: int, avg_deg: int = 14, seed: int = 0) -> sp.csr_matrix:
     return sp.csr_matrix(((a + a.T) > 0).astype(np.float32))
 
 
+def cora_like(n: int = 600, nclasses: int = 7, vocab: int = 64,
+              words_per_doc: int = 12, avg_deg: int = 4,
+              p_intra: float = 0.9, seed: int = 0):
+    """Citation-network generator in cora's exact data format.
+
+    Cora (the reference's accuracy-experiment dataset,
+    ``GPU/PGCN-Accuracy.py`` / ``README.md:110``) is 2708 papers, 7 classes,
+    sparse binary bag-of-words features over a 1433-word vocabulary, citation
+    edges mostly intra-topic.  Zero egress forbids downloading it, so this
+    reproduces the *format and learnability structure*: each class has a
+    preferred word subset (a topic), each document samples ``words_per_doc``
+    words from a mixture of its topic and the background, and citations
+    attach preferentially within class with a heavy-tailed degree profile.
+
+    Returns ``(adjacency csr, features csr binary (n, vocab), labels int32)``.
+    """
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, nclasses, size=n).astype(np.int32)
+    # topic word distributions: each class concentrates on vocab/nclasses words
+    word_logits = np.full((nclasses, vocab), 0.1)
+    block = vocab // nclasses
+    for c in range(nclasses):
+        word_logits[c, c * block:(c + 1) * block] = 3.0
+    word_p = np.exp(word_logits)
+    word_p /= word_p.sum(axis=1, keepdims=True)
+    rows, cols = [], []
+    for i in range(n):
+        w = rng.choice(vocab, size=words_per_doc, replace=False,
+                       p=word_p[labels[i]])
+        rows.extend([i] * len(w))
+        cols.extend(w)
+    feats = sp.csr_matrix(
+        (np.ones(len(rows), np.float32), (rows, cols)), shape=(n, vocab))
+    feats.sum_duplicates()
+    feats.data[:] = 1.0                      # binary bag-of-words, like cora
+    # citations: preferential attachment within class (heavy-tailed degrees)
+    m = n * avg_deg // 2
+    src = rng.integers(0, n, size=2 * m)
+    # heavy tail: square a uniform to bias destinations toward low ids
+    dst_pool = (rng.random(2 * m) ** 2 * n).astype(np.int64)
+    intra = rng.random(2 * m) < p_intra
+    same = labels[src] == labels[dst_pool % n]
+    keep = (src != dst_pool % n) & (intra == same)
+    src, dst = src[keep][:m], (dst_pool % n)[keep][:m]
+    a = sp.coo_matrix((np.ones(len(src), np.float32), (src, dst)),
+                      shape=(n, n))
+    a = sp.csr_matrix(((a + a.T) > 0).astype(np.float32))
+    return a, feats, labels
+
+
+def planetoid_split(labels: np.ndarray, per_class: int = 20,
+                    ntest: int = 1000, seed: int = 0):
+    """Planetoid split semantics: ``per_class`` train nodes per class, a
+    held-out test block of ``ntest`` nodes, the rest unused (the reference's
+    cora run uses this fixed-split protocol; its synthetic-bench splits are
+    random batches, ``GPU/PGCN-Accuracy.py:228-251``).
+
+    Returns ``(train_mask, test_mask)`` float32 0/1 vectors.
+    """
+    labels = np.asarray(labels)
+    n = len(labels)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    train = np.zeros(n, np.float32)
+    for c in np.unique(labels):
+        picks = perm[labels[perm] == c][:per_class]
+        train[picks] = 1.0
+    test = np.zeros(n, np.float32)
+    pool = perm[train[perm] == 0.0]
+    test[pool[-min(ntest, len(pool)):]] = 1.0
+    return train, test
+
+
+# on-disk .npz layout used by the public planetoid/ogbn snapshot dumps
+# (CSR triplets for the graph and the sparse attribute matrix + labels)
+_NPZ_ADJ = ("adj_data", "adj_indices", "adj_indptr", "adj_shape")
+_NPZ_ATTR = ("attr_data", "attr_indices", "attr_indptr", "attr_shape")
+
+
+def save_npz_dataset(path: str, a: sp.spmatrix, features, labels) -> None:
+    """Write the standard sparse-graph ``.npz`` snapshot layout."""
+    a = sp.csr_matrix(a)
+    arrs = dict(zip(_NPZ_ADJ, (a.data, a.indices, a.indptr, a.shape)))
+    if sp.issparse(features):
+        f = sp.csr_matrix(features)
+        arrs.update(zip(_NPZ_ATTR, (f.data, f.indices, f.indptr, f.shape)))
+    else:
+        arrs["attr_matrix"] = np.asarray(features, np.float32)
+    arrs["labels"] = np.asarray(labels)
+    np.savez_compressed(path, **arrs)
+
+
+def load_npz_dataset(path: str):
+    """Read a planetoid/ogbn-style ``.npz`` snapshot.
+
+    Accepts both sparse (``attr_data/indices/indptr/shape``) and dense
+    (``attr_matrix``) feature storage, the two layouts the public snapshot
+    dumps use.  Returns ``(adjacency csr, features float32 ndarray, labels
+    int32)`` — features densified because the trainers consume dense rows.
+    """
+    with np.load(path, allow_pickle=False) as z:
+        a = sp.csr_matrix(
+            (z["adj_data"], z["adj_indices"], z["adj_indptr"]),
+            shape=tuple(z["adj_shape"]))
+        if "attr_matrix" in z:
+            feats = np.asarray(z["attr_matrix"], np.float32)
+        else:
+            feats = np.asarray(sp.csr_matrix(
+                (z["attr_data"], z["attr_indices"], z["attr_indptr"]),
+                shape=tuple(z["attr_shape"])).todense(), np.float32)
+        labels = np.asarray(z["labels"]).astype(np.int32)
+    a = sp.csr_matrix(a, dtype=np.float32)
+    a.sum_duplicates()
+    return a, feats, labels
+
+
 def save_fixture(prefix: str, a: sp.spmatrix,
-                 labels: np.ndarray | None = None) -> dict[str, str]:
-    """Write ``<prefix>.A.mtx`` (normalized Â) and optionally ``<prefix>.Y.mtx``
-    (one-hot labels) — the preprocessor's output family
-    (``preprocess/GrB-GNN-IDG.py:80-88``)."""
+                 labels: np.ndarray | None = None,
+                 features=None) -> dict[str, str]:
+    """Write ``<prefix>.A.mtx`` (normalized Â) and optionally ``<prefix>.H.mtx``
+    (features) / ``<prefix>.Y.mtx`` (one-hot labels) — the preprocessor's
+    output family (``preprocess/GrB-GNN-IDG.py:80-88``)."""
     from ..prep import normalize_adjacency
     from .mtx import write_mtx
     paths = {}
     ahat = normalize_adjacency(sp.csr_matrix(a))
     write_mtx(f"{prefix}.A.mtx", ahat)
     paths["A"] = f"{prefix}.A.mtx"
+    if features is not None:
+        write_mtx(f"{prefix}.H.mtx", sp.csr_matrix(features))
+        paths["H"] = f"{prefix}.H.mtx"
     if labels is not None:
         n = len(labels)
         nclasses = int(labels.max()) + 1

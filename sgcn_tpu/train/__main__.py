@@ -29,7 +29,8 @@ import sys
 
 def main() -> None:
     p = argparse.ArgumentParser(description="sgcn_tpu distributed trainer")
-    p.add_argument("-a", "--adjacency", required=True, help=".mtx adjacency")
+    p.add_argument("-a", "--adjacency", default=None,
+                   help=".mtx adjacency (or use --npz)")
     p.add_argument("-p", "--partvec", required=True,
                    help="part vector: text (.gp/.hp/.rp) or pickle")
     p.add_argument("-b", "--backend", default="jax", choices=["jax", "cpu"])
@@ -61,6 +62,17 @@ def main() -> None:
                    help="apply Â normalization to the input adjacency")
     p.add_argument("--features-mtx", default=None)
     p.add_argument("--labels-mtx", default=None)
+    p.add_argument("--npz", default=None,
+                   help="planetoid/ogbn-style .npz snapshot (adj_* CSR + "
+                        "attr_* + labels); overrides -a/--features-mtx/"
+                        "--labels-mtx")
+    p.add_argument("--experiment", default=None, choices=["accuracy"],
+                   help="accuracy = the PGCN-Accuracy parity experiment "
+                        "(GPU/PGCN-Accuracy.py, README.md:110): train the "
+                        "dense oracle + the partitioned trainer(s) on a "
+                        "planetoid split and report test accuracy for each")
+    p.add_argument("--train-per-class", type=int, default=20,
+                   help="planetoid split: train nodes per class")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -87,7 +99,14 @@ def main() -> None:
     from .fullbatch import FullBatchTrainer, make_train_data
     from .minibatch import MiniBatchTrainer
 
-    a = read_mtx(args.adjacency)
+    feats = labels = None
+    if args.npz:
+        from ..io.datasets import load_npz_dataset
+        a, feats, labels = load_npz_dataset(args.npz)
+    elif args.adjacency:
+        a = read_mtx(args.adjacency)
+    else:
+        raise SystemExit("need -a/--adjacency or --npz")
     if args.normalize:
         a = normalize_adjacency(a)
     n = a.shape[0]
@@ -104,12 +123,14 @@ def main() -> None:
     f = args.nfeatures
     if args.features_mtx:
         feats = np.asarray(read_mtx(args.features_mtx).todense(), np.float32)
+    if feats is not None:
         f = feats.shape[1]
     else:
         # synthetic benchmark harness inputs (GPU/PGCN.py:186-192)
         feats = np.tile(np.arange(n, dtype=np.float32)[:, None], (1, f))
     if args.labels_mtx:
         labels = np.asarray(read_mtx(args.labels_mtx).todense()).argmax(1)
+    if labels is not None:
         nclasses = int(labels.max()) + 1
     else:
         labels = np.arange(n) % f
@@ -120,6 +141,23 @@ def main() -> None:
     widths = [hidden] * (args.nlayers - 1) + [nclasses]
     # PGAT stacks bare modules: no inter-layer nonlinearity unless asked
     activation = args.activation or ("none" if args.model == "gat" else "relu")
+
+    if args.experiment == "accuracy":
+        # the PGCN-Accuracy run (GPU/PGCN-Accuracy.py, README.md:110):
+        # planetoid split, oracle vs partitioned trainers, test accuracy each
+        from ..io.datasets import planetoid_split
+        from .accuracy import run_accuracy_parity
+        train_mask, test_mask = planetoid_split(
+            labels, per_class=args.train_per_class, seed=args.seed)
+        report = run_accuracy_parity(
+            a, feats, labels, pv, k, widths, train_mask, test_mask,
+            epochs=args.epochs, batch_size=args.batch_size, lr=args.lr,
+            seed=args.seed)
+        report["experiment"] = "accuracy"
+        report["backend"] = args.backend
+        if ctx.is_coordinator:
+            print(json.dumps(report), flush=True)
+        return
 
     if args.batch_size is not None:
         tr = MiniBatchTrainer(a, pv, k, fin=f, widths=widths,
