@@ -133,9 +133,9 @@ def cora_like(n: int = 600, nclasses: int = 7, vocab: int = 64,
     # heavy tail: square a uniform to bias destinations toward low ids
     dst_pool = (rng.random(2 * m) ** 2 * n).astype(np.int64)
     intra = rng.random(2 * m) < p_intra
-    same = labels[src] == labels[dst_pool % n]
-    keep = (src != dst_pool % n) & (intra == same)
-    src, dst = src[keep][:m], (dst_pool % n)[keep][:m]
+    same = labels[src] == labels[dst_pool]
+    keep = (src != dst_pool) & (intra == same)
+    src, dst = src[keep][:m], dst_pool[keep][:m]
     a = sp.coo_matrix((np.ones(len(src), np.float32), (src, dst)),
                       shape=(n, n))
     a = sp.csr_matrix(((a + a.T) > 0).astype(np.float32))
@@ -192,16 +192,18 @@ def load_npz_dataset(path: str):
     dumps use.  Returns ``(adjacency csr, features float32 ndarray, labels
     int32)`` — features densified because the trainers consume dense rows.
     """
+    adj_data, adj_indices, adj_indptr, adj_shape = _NPZ_ADJ
+    attr_data, attr_indices, attr_indptr, attr_shape = _NPZ_ATTR
     with np.load(path, allow_pickle=False) as z:
         a = sp.csr_matrix(
-            (z["adj_data"], z["adj_indices"], z["adj_indptr"]),
-            shape=tuple(z["adj_shape"]))
+            (z[adj_data], z[adj_indices], z[adj_indptr]),
+            shape=tuple(z[adj_shape]))
         if "attr_matrix" in z:
             feats = np.asarray(z["attr_matrix"], np.float32)
         else:
             feats = np.asarray(sp.csr_matrix(
-                (z["attr_data"], z["attr_indices"], z["attr_indptr"]),
-                shape=tuple(z["attr_shape"])).todense(), np.float32)
+                (z[attr_data], z[attr_indices], z[attr_indptr]),
+                shape=tuple(z[attr_shape])).todense(), np.float32)
         labels = np.asarray(z["labels"]).astype(np.int32)
     a = sp.csr_matrix(a, dtype=np.float32)
     a.sum_duplicates()

@@ -64,8 +64,9 @@ def main() -> None:
     p.add_argument("--labels-mtx", default=None)
     p.add_argument("--npz", default=None,
                    help="planetoid/ogbn-style .npz snapshot (adj_* CSR + "
-                        "attr_* + labels); overrides -a/--features-mtx/"
-                        "--labels-mtx")
+                        "attr_* + labels); replaces -a, and supplies "
+                        "features/labels unless --features-mtx/--labels-mtx "
+                        "explicitly override them")
     p.add_argument("--experiment", default=None, choices=["accuracy"],
                    help="accuracy = the PGCN-Accuracy parity experiment "
                         "(GPU/PGCN-Accuracy.py, README.md:110): train the "
@@ -144,7 +145,16 @@ def main() -> None:
 
     if args.experiment == "accuracy":
         # the PGCN-Accuracy run (GPU/PGCN-Accuracy.py, README.md:110):
-        # planetoid split, oracle vs partitioned trainers, test accuracy each
+        # planetoid split, oracle vs partitioned trainers, test accuracy each.
+        # The parity harness compares against the dense GCN oracle, so it is
+        # defined for the gcn/xent/relu/f32 configuration only — reject other
+        # flags instead of silently mislabeling a default-config run.
+        if (args.model != "gcn" or args.loss != "xent" or args.dtype
+                or (args.activation or "relu") != "relu"):
+            raise SystemExit(
+                "--experiment accuracy compares against the dense GCN oracle "
+                "and supports only --model gcn --loss xent --activation relu "
+                "(f32); drop the conflicting flags")
         from ..io.datasets import planetoid_split
         from .accuracy import run_accuracy_parity
         train_mask, test_mask = planetoid_split(
