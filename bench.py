@@ -64,29 +64,37 @@ def bench_jax(ahat, feats, labels, widths, epochs: int):
     trainer = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths, mesh=mesh)
     data = make_train_data(plan, feats, labels)
     data = type(data)(**shard_stacked(mesh, vars(data)))
-    trainer.step(data)                            # warm-up (compile) + sync
-    # step(sync=True) blocks only on the loss scalar; force the warm-up Adam
-    # update fully retired before timing (block the whole param tree, then a
-    # scalar readback — block_until_ready alone can return early through the
-    # tunnel on shard_map outputs)
-    jax.block_until_ready(trainer.params)
-    float(np.asarray(jax.tree.leaves(trainer.params)[-1]).ravel()[0])
-    # median of per-round timings: the tunneled chip is shared, single runs
-    # can be 2x noisy. Steps within a round are dispatched asynchronously and
-    # the round blocks once on the last loss scalar — one host round-trip per
-    # round (the tunnel's ~90 ms RTT would otherwise swamp per-epoch time;
-    # a host-attached TPU pays µs for the same dispatch).
-    rounds = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        loss = None
-        for _ in range(epochs):
-            loss = trainer.step(data, sync=False)
-        loss_val = float(loss[()])                # block on the final scalar
-        rounds.append((time.perf_counter() - t0) / epochs)
-        if not np.isfinite(loss_val):
-            raise RuntimeError(f"non-finite loss {loss_val}")
-    return statistics.median(rounds), part_metrics
+    # DIFFERENTIAL timing (round-3 protocol): this box reaches its chip
+    # through a tunnel whose fixed cost per jitted call is ~110 ms; dividing
+    # a round's wall-clock by its epoch count silently adds 110ms/epochs to
+    # the result (every round-1/2 number did).  Instead run `lo` and `hi`
+    # epochs as single on-device fori_loop programs (run_epochs) and report
+    # (t_hi - t_lo)/(hi - lo): the per-call constant cancels exactly,
+    # leaving pure device time per epoch — what a host-attached TPU would
+    # see, and the reference's "timed epochs after warm-up" quantity
+    # (GPU/PGCN.py:202-228).
+    lo, hi = 1, max(3, epochs)
+
+    def measure(nep):
+        losses = trainer.run_epochs(data, nep, sync=False)   # compile + warm
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            losses = trainer.run_epochs(data, nep, sync=False)
+            last = float(losses[-1])              # scalar readback = sync
+            ts.append(time.perf_counter() - t0)
+            if not np.isfinite(last):
+                raise RuntimeError(f"non-finite loss {last}")
+        return statistics.median(ts)
+
+    for attempt in range(3):
+        t_lo, t_hi = measure(lo), measure(hi)
+        if t_hi > t_lo:
+            return (t_hi - t_lo) / (hi - lo), part_metrics
+    # never fabricate a near-zero flagship number out of tunnel noise
+    raise RuntimeError(
+        f"differential timing failed: t({hi} ep)={t_hi:.4f}s <= "
+        f"t({lo} ep)={t_lo:.4f}s after 3 attempts (chip contention?)")
 
 
 def bench_dense_equiv(n: int, fin: int, widths, epochs: int) -> float:
@@ -122,23 +130,36 @@ def bench_dense_equiv(n: int, fin: int, widths, epochs: int) -> float:
         logp = jax.nn.log_softmax(h)
         return -logp[jnp.arange(n), labels].mean()
 
-    @jax.jit
-    def step(ps, st):
-        loss, g = jax.value_and_grad(loss_fn)(ps)
-        up, st = opt.update(g, st, ps)
-        return optax.apply_updates(ps, up), st, loss
+    def multi(nep):
+        @jax.jit
+        def run(ps, st):
+            def body(i, c):
+                ps, st, _ = c
+                loss, g = jax.value_and_grad(loss_fn)(ps)
+                up, st = opt.update(g, st, ps)
+                return optax.apply_updates(ps, up), st, loss
+            return jax.lax.fori_loop(0, nep, body,
+                                     (ps, st, jnp.float32(0)))
+        return run
 
-    params, opt_state, loss = step(params, opt_state)   # warm-up (compile)
-    jax.block_until_ready(params)
-    float(loss)
-    rounds = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        for _ in range(epochs):
-            params, opt_state, loss = step(params, opt_state)
-        float(loss)                               # block once per round
-        rounds.append((time.perf_counter() - t0) / epochs)
-    return statistics.median(rounds)
+    # same differential protocol as bench_jax (tunnel per-call constant)
+    lo, hi = 1, max(3, epochs)
+
+    def measure(nep):
+        run = multi(nep)
+        float(run(params, opt_state)[2])          # compile + warm
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(run(params, opt_state)[2])      # scalar readback = sync
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+
+    for attempt in range(3):
+        t_lo, t_hi = measure(lo), measure(hi)
+        if t_hi > t_lo:
+            return (t_hi - t_lo) / (hi - lo)
+    return float("nan")       # diagnostic yardstick only; caller emits null
 
 
 def bench_torch_reference(ahat, feats, labels, widths, epochs: int) -> float:
@@ -265,8 +286,10 @@ def main() -> None:
         "unit": "s",
         "vs_baseline": vs,
         "vs_torch_cpu": vs,
-        "dense_equiv_s": round(dense_s, 6) if dense_s else None,
-        "epoch_vs_dense": round(epoch_s / dense_s, 3) if dense_s else None,
+        "dense_equiv_s": round(dense_s, 6)
+            if dense_s and np.isfinite(dense_s) else None,
+        "epoch_vs_dense": round(epoch_s / dense_s, 3)
+            if dense_s and np.isfinite(dense_s) else None,
         **part_metrics,
         **vdev_metrics,
     }))

@@ -123,6 +123,28 @@ def test_bf16_compute_tracks_f32(ahat):
     assert l16[-1] < l16[0]
 
 
+def test_run_epochs_matches_sequential_steps(ahat):
+    """The on-device epoch loop (one dispatch, lax.fori_loop) must follow the
+    exact trajectory of sequential step() calls — it exists purely to remove
+    per-dispatch host latency from multi-epoch timing (bench protocol)."""
+    n = ahat.shape[0]
+    feats, labels = _dataset(ahat)
+    pv = balanced_random_partition(n, 4, seed=13)
+    plan = build_comm_plan(ahat, pv, 4)
+    data = make_train_data(plan, feats, labels)
+    seq = FullBatchTrainer(plan, fin=feats.shape[1], widths=[8, 3], seed=7)
+    fused = FullBatchTrainer(plan, fin=feats.shape[1], widths=[8, 3], seed=7)
+    seq_losses = [seq.step(data) for _ in range(5)]
+    fused_losses = fused.run_epochs(data, 5)
+    np.testing.assert_allclose(fused_losses, seq_losses, rtol=2e-5, atol=1e-6)
+    # params identical afterward, and stats counted all 5 steps
+    for a, b in zip(np.asarray(seq.params, dtype=object).ravel(),
+                    np.asarray(fused.params, dtype=object).ravel()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+    assert fused.stats.exchanges == seq.stats.exchanges
+
+
 def test_remat_matches_plain(ahat):
     """jax.checkpoint rematerialization must not change the math."""
     import numpy as np
