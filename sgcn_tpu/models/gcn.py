@@ -65,6 +65,7 @@ def gcn_forward_local(
     activation: str = "relu",
     final_activation: str = "none",
     symmetric: bool = False,
+    ell_buckets: tuple | None = None,   # static plan.ell_buckets (sym path)
     axis_name: str = AXIS,
 ):
     """Per-chip forward: L × (pspmm ⊗ dense matmul → activation) → (B, nout).
@@ -89,11 +90,16 @@ def gcn_forward_local(
     nl = len(params)
 
     if symmetric:
+        if ell_buckets is None:
+            raise ValueError(
+                "symmetric GCN forward needs the plan's static ell_buckets")
+
         def agg(x):
             return pspmm_ell_sym(
                 x, pa["send_idx"], pa["halo_src"], pa["ell_idx"], pa["ell_w"],
                 pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
-                pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"], axis_name)
+                pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
+                ell_buckets, axis_name)
     else:
         def agg(x):
             return pspmm_overlap(

@@ -114,41 +114,52 @@ def pspmm_overlap(h, send_idx, halo_src,
     return local + remote
 
 
-def spmm_ell(ell_idx, ell_w, tail_dst, tail_src, tail_w, h):
-    """Local SpMM in fixed-width ELL layout + COO overflow tail.
+def spmm_ell(ell_idx, ell_w, tail_dst, tail_src, tail_w, h, buckets):
+    """Local SpMM in bucketed-ELL layout + COO overflow tail.
 
-    ``out[i] = Σ_j ell_w[i,j]·h[ell_idx[i,j]] (+ tail scatter-adds)``.  The
-    reduce over the width axis is dense, so XLA fuses it straight into the
-    gather — no segment-sum machinery.  Measured on v5e at ogbn-arxiv scale
-    (n=169k, deg 15, f=128): 16 ms vs 41 ms for the sorted-COO segment-sum;
-    the gather itself is a pattern-independent per-row access cost, so this
-    sits at the hardware gather floor.
+    ``buckets = ((nb, wb), ...)`` is the plan's static degree-bucket
+    structure (``sgcn_tpu.parallel.plan``): the next ``nb`` output rows each
+    own ``wb`` flat slots of ``ell_idx``/``ell_w``.  Per bucket this is one
+    2D-index gather + dense weighted width-reduce — XLA emits the gather
+    producing ``(nb, wb, f)`` directly (a flat-index + reshape form forced
+    physical relayouts of the whole gathered block, ~30 ms/epoch of "data
+    formatting" at ogbn-arxiv scale in the round-3 trace), and the einsum
+    fuses into the gather consumer.  The v5e gather is row-rate-bound
+    (~350-400 Mrows/s, pattern/dtype-independent), so the bucketed layout's
+    ~1.1-1.2× padding vs single-width ELL's ~1.7× is a direct time saving.
     """
-    # 2D-index gather: XLA emits ONE gather producing (B, kk, f) directly —
-    # the flat-index + reshape form forced a physical relayout of the whole
-    # gathered block (measured as ~30 ms/epoch of "data formatting" at
-    # ogbn-arxiv scale in the round-3 profiler trace); einsum fuses the
-    # weighted width-reduce into the gather consumer.
-    g = jnp.take(h, ell_idx, axis=0)                   # (B, kk, f)
-    out = jnp.einsum("nkf,nk->nf", g, ell_w)
+    if sum(nb * wb for nb, wb in buckets) != ell_idx.shape[0]:
+        raise ValueError(
+            f"bucket structure {buckets} does not cover the flat ELL arrays "
+            f"({ell_idx.shape[0]} slots) — pass the owning plan's ell_buckets")
+    outs = []
+    off = 0
+    for nb, wb in buckets:
+        idx = ell_idx[off: off + nb * wb].reshape(nb, wb)
+        wv = ell_w[off: off + nb * wb].reshape(nb, wb)
+        g = jnp.take(h, idx, axis=0)                   # (nb, wb, f)
+        outs.append(jnp.einsum("nkf,nk->nf", g, wv))
+        off += nb * wb
+    out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
     tg = jnp.take(h, tail_src, axis=0) * tail_w[:, None]
     return out.at[tail_dst].add(tg)
 
 
 def _pspmm_ell_once(h, send_idx, halo_src, ell_idx, ell_w,
                     ltail_dst, ltail_src, ltail_w,
-                    hedge_dst, hedge_src, hedge_w, axis_name):
+                    hedge_dst, hedge_src, hedge_w, buckets, axis_name):
     halo = halo_exchange(h, send_idx, halo_src, axis_name)
     # local ELL aggregation has no data dependence on the exchange (overlap)
-    local = spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, h)
+    local = spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, h, buckets)
     remote = spmm_local(hedge_dst, hedge_src, hedge_w, halo, h.shape[0])
     return local + remote
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(11,))
+@partial(jax.custom_vjp, nondiff_argnums=(11, 12))
 def pspmm_ell_sym(h, send_idx, halo_src, ell_idx, ell_w,
                   ltail_dst, ltail_src, ltail_w,
-                  hedge_dst, hedge_src, hedge_w, axis_name=AXIS):
+                  hedge_dst, hedge_src, hedge_w, buckets,
+                  axis_name=AXIS):
     """``PSpMM`` for a SYMMETRIC Â: ELL local aggregation + overlap structure,
     with a custom backward that reuses the forward form.
 
@@ -166,26 +177,26 @@ def pspmm_ell_sym(h, send_idx, halo_src, ell_idx, ell_w,
     """
     return _pspmm_ell_once(h, send_idx, halo_src, ell_idx, ell_w,
                            ltail_dst, ltail_src, ltail_w,
-                           hedge_dst, hedge_src, hedge_w, axis_name)
+                           hedge_dst, hedge_src, hedge_w, buckets, axis_name)
 
 
 def _pspmm_ell_sym_fwd(h, send_idx, halo_src, ell_idx, ell_w,
                        ltail_dst, ltail_src, ltail_w,
-                       hedge_dst, hedge_src, hedge_w, axis_name):
+                       hedge_dst, hedge_src, hedge_w, buckets, axis_name):
     out = _pspmm_ell_once(h, send_idx, halo_src, ell_idx, ell_w,
                           ltail_dst, ltail_src, ltail_w,
-                          hedge_dst, hedge_src, hedge_w, axis_name)
+                          hedge_dst, hedge_src, hedge_w, buckets, axis_name)
     res = (send_idx, halo_src, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
            hedge_dst, hedge_src, hedge_w)
     return out, res
 
 
-def _pspmm_ell_sym_bwd(axis_name, res, g):
+def _pspmm_ell_sym_bwd(buckets, axis_name, res, g):
     (send_idx, halo_src, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
      hedge_dst, hedge_src, hedge_w) = res
     gh = _pspmm_ell_once(g, send_idx, halo_src, ell_idx, ell_w,
                          ltail_dst, ltail_src, ltail_w,
-                         hedge_dst, hedge_src, hedge_w, axis_name)
+                         hedge_dst, hedge_src, hedge_w, buckets, axis_name)
     zeros = [None] * 10
     return (gh, *zeros)
 

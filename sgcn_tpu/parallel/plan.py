@@ -90,22 +90,29 @@ class CommPlan:
     lnnz: np.ndarray          # (k,) true local-src nnz
     hnnz: np.ndarray          # (k,) true halo-src nnz
 
-    # The local-src edges again, in fixed-width ELL layout: row i's first
-    # ``ell_k`` local in-edges sit in ``ell_idx[i]``/``ell_w[i]`` (src index /
-    # weight, zero-padded), overflow spills to a COO tail.  The hot SpMM
-    # becomes gather + DENSE weighted reduce over the width axis — no
-    # segment machinery, so XLA fuses the reduce into the gather consumer.
-    # Measured on v5e at ogbn-arxiv scale (n=169k, f=128): 16 ms vs 41 ms
-    # for the sorted-COO segment-sum, with the gather itself ~16 ms
-    # (pattern-independent per-row access cost; locality does not matter).
-    ell_k: int                # ELL width (always >= 1)
+    # The local-src edges again, in BUCKETED ELL layout.  Rows are stored in
+    # degree buckets: bucket j covers the next ``nb_j`` rows at fixed width
+    # ``wb_j`` (``ell_buckets = ((nb_0, wb_0), ...)``, Σ nb_j = B), and row
+    # r's in-edges occupy ``wb_j`` flat slots starting at its bucket base.
+    # The hot SpMM is, per bucket, ONE 2D-index gather + dense weighted
+    # reduce over the width axis — no segment machinery, no scatter.  Under
+    # ``row_order='degree'`` (the trainer default) rows are relabeled
+    # descending by local in-degree, so bucket widths hug the degree profile
+    # and padding drops from the single-width ELL's ~1.7× (Poisson graphs)
+    # to ~1.1-1.2×; the gather is row-rate-bound on v5e (~350-400 Mrows/s
+    # regardless of index pattern or dtype), so fewer gathered rows is the
+    # only lever that pays.  Under ``row_order='id'`` a single bucket plus
+    # the COO overflow tail reproduces the classic ELL+tail layout.
+    ell_k: int                # max bucket width (informational; >= 1)
     tl: int                   # padded tail length
-    ell_idx: np.ndarray       # (k, B, ell_k) int32 local src, 0 on padding
-    ell_w: np.ndarray         # (k, B, ell_k) float32, 0 on padding
+    ell_buckets: tuple        # ((nb, wb), ...) static bucket structure
+    ell_idx: np.ndarray       # (k, ET) int32 flat local src, 0 on padding
+    ell_w: np.ndarray         # (k, ET) float32 flat, 0 on padding
     ltail_dst: np.ndarray     # (k, TL) int32
     ltail_src: np.ndarray     # (k, TL) int32
     ltail_w: np.ndarray       # (k, TL) float32, 0 on padding
     ltail_nnz: np.ndarray     # (k,) true tail nnz
+    row_order: str            # 'degree' (bucketed) or 'id' (emit-compatible)
 
     # True when the global adjacency is numerically symmetric (Â = Âᵀ) —
     # verified at plan-build time.  Lets the SpMM backward reuse the forward
@@ -150,11 +157,14 @@ class CommPlan:
         return np.asarray(blocks)[self.owner, self.local_idx]
 
 
-def _relabel(n: int, partvec: np.ndarray, k: int, pad_rows_to: int):
+def _relabel(n: int, partvec: np.ndarray, k: int, pad_rows_to: int,
+             order_key: np.ndarray | None = None):
     """Shared vertex relabeling: (owner, local_idx, part_sizes, b, row_valid).
 
-    Chip ``p`` owns local slots 0..B-1, vertices ranked by global id within
-    their part; single source of truth for both plan builders below.
+    Chip ``p`` owns local slots 0..B-1.  Within a part, vertices are ranked
+    by global id (``order_key=None``) or descending by ``order_key`` with
+    global id as the tie-break — the degree ordering that makes the bucketed
+    ELL layout tight.  Single source of truth for both plan builders below.
     """
     owner = np.asarray(partvec, dtype=np.int64)
     if owner.shape[0] != n:
@@ -164,7 +174,10 @@ def _relabel(n: int, partvec: np.ndarray, k: int, pad_rows_to: int):
     part_sizes = np.bincount(owner, minlength=k)
     b = int(part_sizes.max()) if n else 1
     b = max(1, -(-b // pad_rows_to) * pad_rows_to)
-    order = np.lexsort((np.arange(n), owner))
+    if order_key is None:
+        order = np.lexsort((np.arange(n), owner))
+    else:
+        order = np.lexsort((np.arange(n), -np.asarray(order_key), owner))
     local_idx = np.empty(n, dtype=np.int64)
     starts = np.zeros(k + 1, dtype=np.int64)
     np.cumsum(part_sizes, out=starts[1:])
@@ -216,33 +229,104 @@ def _split_edges(edge_dst, edge_src, edge_w, nnz, b,
                 hedge_dst=hd, hedge_src=hs, hedge_w=hw, lnnz=lnnz, hnnz=hnnz)
 
 
-def _build_ell(ledge_dst, ledge_src, ledge_w, lnnz, b,
-               ell_k: int | None = None, tl: int | None = None,
-               tail_frac: float = 0.02):
-    """Fixed-width ELL layout of the local-src edge lists + COO tail.
+def ell_degree_profile(ledge_dst, lnnz, b) -> np.ndarray:
+    """Pointwise max over chips of the per-row local in-degree, (b,)."""
+    k = ledge_dst.shape[0]
+    prof = np.zeros(b, dtype=np.int64)
+    for p in range(k):
+        np.maximum(prof,
+                   np.bincount(ledge_dst[p, : int(lnnz[p])], minlength=b),
+                   out=prof)
+    return prof
 
-    The width is the smallest multiple of 4 whose overflow tail holds at
-    most ``tail_frac`` of the local edges (capped at the max local degree):
-    wide enough that almost all edges take the fused gather+dense-reduce
-    path, narrow enough that padding gathers stay cheap on power-law
-    graphs whose hubs would otherwise blow the width up.
+
+def _choose_buckets(profile: np.ndarray, max_buckets: int = 6) -> tuple:
+    """Optimal ≤``max_buckets`` contiguous row buckets for a DESCENDING
+    degree profile, minimizing total padded slots Σ nb·wb (wb = max degree
+    in the bucket = degree at its first row).  DP over degree-change points,
+    subsampled to 64 candidates on graphs with many distinct degrees."""
+    b = len(profile)
+    d = np.maximum(np.asarray(profile, dtype=np.int64), 0)
+    cuts = [0] + [i for i in range(1, b) if d[i] != d[i - 1]] + [b]
+    if len(cuts) > 65:
+        keep = np.unique(np.linspace(0, len(cuts) - 1, 65).astype(int))
+        cuts = [cuts[i] for i in keep]
+    m = len(cuts)
+    inf = float("inf")
+    best = [[inf] * (max_buckets + 1) for _ in range(m)]
+    back = [[0] * (max_buckets + 1) for _ in range(m)]
+    best[0][0] = 0.0
+    for j in range(1, m):
+        for q in range(1, max_buckets + 1):
+            for i in range(j):
+                if best[i][q - 1] == inf:
+                    continue
+                w = max(int(d[cuts[i]]), 1)
+                c = best[i][q - 1] + (cuts[j] - cuts[i]) * w
+                if c < best[j][q]:
+                    best[j][q] = c
+                    back[j][q] = i
+    q = min(range(1, max_buckets + 1), key=lambda t: best[m - 1][t])
+    segs = []
+    j = m - 1
+    while j > 0:
+        i = back[j][q]
+        segs.append((cuts[j] - cuts[i], max(int(d[cuts[i]]), 1)))
+        j, q = i, q - 1
+    return tuple(reversed(segs))
+
+
+def _single_bucket_width(alldeg: np.ndarray, tail_frac: float) -> int:
+    """Classic ELL width choice: smallest multiple of 4 whose overflow tail
+    holds at most ``tail_frac`` of the edges (capped at the max degree)."""
+    maxdeg = int(alldeg.max()) if alldeg.size else 0
+    total = max(1, int(alldeg.sum()))
+    ell_k = 4
+    while ell_k < maxdeg:
+        if int(np.maximum(alldeg - ell_k, 0).sum()) <= tail_frac * total:
+            break
+        ell_k += 4
+    return min(ell_k, max(maxdeg, 1))
+
+
+def _build_ell(ledge_dst, ledge_src, ledge_w, lnnz, b,
+               row_order: str = "degree",
+               buckets: tuple | None = None, tl: int | None = None,
+               tail_frac: float = 0.02, max_buckets: int = 6):
+    """Bucketed-ELL layout of the local-src edge lists (see CommPlan).
+
+    ``row_order='degree'`` (rows pre-sorted descending by local degree):
+    bucket structure from ``_choose_buckets`` — or ``buckets`` forced, for
+    mini-batch plans sharing one compiled envelope — and NO overflow tail.
+    ``row_order='id'``: one bucket of the classic tail-bounded width plus
+    the COO overflow tail (emit-compatible row numbering).
     """
     k = ledge_dst.shape[0]
     degs = [np.bincount(ledge_dst[p, : int(lnnz[p])], minlength=b)
             for p in range(k)]
-    alldeg = np.concatenate(degs) if k else np.zeros(1, np.int64)
-    maxdeg = int(alldeg.max()) if alldeg.size else 0
-    total = max(1, int(alldeg.sum()))
-    if ell_k is None:
-        ell_k = 4
-        while ell_k < maxdeg:
-            tail = int(np.maximum(alldeg - ell_k, 0).sum())
-            if tail <= tail_frac * total:
-                break
-            ell_k += 4
-        ell_k = min(ell_k, max(maxdeg, 1))
-    ell_idx = np.zeros((k, b, ell_k), dtype=np.int32)
-    ell_wv = np.zeros((k, b, ell_k), dtype=np.float32)
+    if buckets is None:
+        if row_order == "degree":
+            prof = np.zeros(b, dtype=np.int64)
+            for dg in degs:
+                np.maximum(prof, dg, out=prof)
+            buckets = _choose_buckets(prof, max_buckets=max_buckets)
+        else:
+            alldeg = (np.concatenate(degs) if k else np.zeros(1, np.int64))
+            buckets = ((b, _single_bucket_width(alldeg, tail_frac)),)
+    if sum(nb for nb, _ in buckets) != b:
+        raise ValueError(f"buckets {buckets} do not cover {b} rows")
+    et = sum(nb * wb for nb, wb in buckets)
+    # flat slot base and width per row
+    row_base = np.empty(b, dtype=np.int64)
+    row_cap = np.empty(b, dtype=np.int64)
+    off = r0 = 0
+    for nb, wb in buckets:
+        row_base[r0: r0 + nb] = off + np.arange(nb, dtype=np.int64) * wb
+        row_cap[r0: r0 + nb] = wb
+        off += nb * wb
+        r0 += nb
+    ell_idx = np.zeros((k, et), dtype=np.int32)
+    ell_wv = np.zeros((k, et), dtype=np.float32)
     tails = []
     for p in range(k):
         cnt = int(lnnz[p])
@@ -253,9 +337,11 @@ def _build_ell(ledge_dst, ledge_src, ledge_w, lnnz, b,
         starts = np.zeros(b + 1, dtype=np.int64)
         np.cumsum(degs[p], out=starts[1:])
         pos = np.arange(cnt) - starts[d]
-        main = pos < ell_k
-        ell_idx[p].reshape(-1)[d[main] * ell_k + pos[main]] = s0[main]
-        ell_wv[p].reshape(-1)[d[main] * ell_k + pos[main]] = w[main]
+        main = pos < row_cap[d]
+        if row_order == "degree" and not main.all():
+            raise ValueError("bucket envelope smaller than a row's degree")
+        ell_idx[p][row_base[d[main]] + pos[main]] = s0[main]
+        ell_wv[p][row_base[d[main]] + pos[main]] = w[main]
         tails.append((d[~main].astype(np.int32), s0[~main], w[~main]))
     ltail_nnz = np.array([len(t[0]) for t in tails], dtype=np.int64)
     tl_nat = max(1, int(ltail_nnz.max()) if k else 1)
@@ -269,9 +355,24 @@ def _build_ell(ledge_dst, ledge_src, ledge_w, lnnz, b,
         ltail_dst[p, : len(d)] = d
         ltail_src[p, : len(s0)] = s0
         ltail_w[p, : len(w)] = w
-    return dict(ell_k=ell_k, tl=tl, ell_idx=ell_idx, ell_w=ell_wv,
+    return dict(ell_k=max(wb for _, wb in buckets), tl=tl,
+                ell_buckets=buckets, ell_idx=ell_idx, ell_w=ell_wv,
                 ltail_dst=ltail_dst, ltail_src=ltail_src, ltail_w=ltail_w,
                 ltail_nnz=ltail_nnz)
+
+
+def shared_ell_buckets(plans: list, b: int) -> tuple:
+    """Bucket structure covering every plan's degree profile — the shared
+    compiled-envelope companion to ``pad_comm_plan`` for mini-batch plans
+    (all padded to ``b`` rows)."""
+    prof = np.zeros(b, dtype=np.int64)
+    for pl in plans:
+        q = ell_degree_profile(pl.ledge_dst, pl.lnnz, pl.b)
+        np.maximum(prof[: pl.b], q, out=prof[: pl.b])
+    if all(pl.row_order == "degree" for pl in plans):
+        return _choose_buckets(prof)
+    # id-ordered rows: one classic tail-bounded width shared by all
+    return ((b, max(pl.ell_k for pl in plans)),)
 
 
 def _check_symmetric(a: sp.spmatrix) -> bool:
@@ -324,17 +425,18 @@ def relabel_plan(a: sp.spmatrix, partvec: np.ndarray, k: int,
         hedge_dst=z((k, 1), np.int32), hedge_src=z((k, 1), np.int32),
         hedge_w=z((k, 1), np.float32),
         lnnz=z(k, np.int64), hnnz=z(k, np.int64),
-        ell_k=1, tl=1,
-        ell_idx=z((k, b, 1), np.int32), ell_w=z((k, b, 1), np.float32),
+        ell_k=1, tl=1, ell_buckets=((b, 1),),
+        ell_idx=z((k, b), np.int32), ell_w=z((k, b), np.float32),
         ltail_dst=z((k, 1), np.int32), ltail_src=z((k, 1), np.int32),
         ltail_w=z((k, 1), np.float32), ltail_nnz=z(k, np.int64),
-        symmetric=_check_symmetric(a),
+        symmetric=_check_symmetric(a), row_order="id",
     )
 
 
 def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
                   el: int | None = None, eh: int | None = None,
-                  ell_k: int | None = None, tl: int | None = None) -> CommPlan:
+                  tl: int | None = None,
+                  ell_buckets: tuple | None = None) -> CommPlan:
     """Re-pad a plan to a larger (B, S, R, E) envelope.
 
     Lets many plans (one per mini-batch) share ONE compiled train step: the
@@ -343,19 +445,19 @@ def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
     padding every batch plan to the max envelope so shapes are static
     (SURVEY.md §7.3).  Padding preserves the plan invariants: pad edges carry
     weight 0 and dst ``b-1`` (keeps ``edge_dst`` non-decreasing), pad send /
-    halo slots index row 0 and are never read by valid gathers.
+    halo slots index row 0 and are never read by valid gathers.  For the
+    shared ELL layout pass ``ell_buckets`` covering every plan's degree
+    profile (see ``ell_degree_profile`` / ``_choose_buckets``).
     """
     el = plan.el if el is None else el
     eh = plan.eh if eh is None else eh
-    ell_k = plan.ell_k if ell_k is None else ell_k
     tl = plan.tl if tl is None else tl
-    if (b, s, r, e, el, eh, ell_k, tl) == (
-            plan.b, plan.s, plan.r, plan.e, plan.el, plan.eh,
-            plan.ell_k, plan.tl):
+    if (b, s, r, e, el, eh, tl) == (
+            plan.b, plan.s, plan.r, plan.e, plan.el, plan.eh, plan.tl) \
+            and ell_buckets in (None, plan.ell_buckets):
         return plan
     if (b < plan.b or s < plan.s or r < plan.r or e < plan.e
-            or el < plan.el or eh < plan.eh or ell_k < plan.ell_k
-            or tl < plan.tl):
+            or el < plan.el or eh < plan.eh or tl < plan.tl):
         raise ValueError("pad_comm_plan cannot shrink an envelope")
     k = plan.k
 
@@ -383,7 +485,8 @@ def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
 
     split = _split_edges(edge_dst, edge_src, edge_w, plan.nnz, b, el=el, eh=eh)
     ell = _build_ell(split["ledge_dst"], split["ledge_src"], split["ledge_w"],
-                     split["lnnz"], b, ell_k=ell_k, tl=tl)
+                     split["lnnz"], b, row_order=plan.row_order,
+                     buckets=ell_buckets, tl=tl)
     return CommPlan(
         n=plan.n, k=k, b=b, s=s, r=r, e=e,
         owner=plan.owner, local_idx=plan.local_idx, part_sizes=plan.part_sizes,
@@ -391,7 +494,7 @@ def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
         halo_src=halo_src, halo_counts=plan.halo_counts.copy(),
         edge_dst=edge_dst, edge_src=edge_src, edge_w=edge_w,
         nnz=plan.nnz.copy(), row_valid=row_valid,
-        symmetric=plan.symmetric, **split, **ell,
+        symmetric=plan.symmetric, row_order=plan.row_order, **split, **ell,
     )
 
 
@@ -401,6 +504,7 @@ def build_comm_plan(
     k: int,
     pad_rows_to: int = 1,
     pad_send_to: int = 1,
+    row_order: str = "degree",
 ) -> CommPlan:
     """Compute the static plan from adjacency + part vector.
 
@@ -408,11 +512,24 @@ def build_comm_plan(
     for TPU sublane alignment). The recv side of the reference's map predicate
     (nonzero with local row, remote col → receive that col's row;
     ``GPU/PGCN.py:37-51``) defines the halo; the send side is its transpose.
+
+    ``row_order='degree'`` (default) relabels each part's rows descending by
+    local in-degree so the bucketed ELL layout is tight; any consistent
+    order is correct (all row data routes through owner/local_idx), so this
+    is purely a layout choice.  ``row_order='id'`` ranks by global id —
+    required by the ``.r``-file emitter whose text formats assume it.
     """
     a = sp.coo_matrix(a)
     n = a.shape[0]
+    if row_order not in ("degree", "id"):
+        raise ValueError(f"unknown row_order {row_order!r}")
+    key = None
+    if row_order == "degree":
+        ow = np.asarray(partvec, dtype=np.int64)
+        local_edge = ow[a.row] == ow[a.col]
+        key = np.bincount(a.row[local_edge], minlength=n)
     owner, local_idx, part_sizes, b, row_valid = _relabel(
-        n, partvec, k, pad_rows_to)
+        n, partvec, k, pad_rows_to, order_key=key)
 
     src_g, dst_g, w_g = a.col, a.row, a.data.astype(np.float32)
     eo = owner[dst_g]                                   # chip owning each edge (by row)
@@ -495,7 +612,7 @@ def build_comm_plan(
 
     split = _split_edges(edge_dst, edge_src, edge_w, nnz, b)
     ell = _build_ell(split["ledge_dst"], split["ledge_src"], split["ledge_w"],
-                     split["lnnz"], b)
+                     split["lnnz"], b, row_order=row_order)
     return CommPlan(
         n=n, k=k, b=b, s=s, r=r, e=e,
         owner=owner, local_idx=local_idx, part_sizes=part_sizes.astype(np.int64),
@@ -503,5 +620,5 @@ def build_comm_plan(
         halo_src=halo_src, halo_counts=halo_counts,
         edge_dst=edge_dst, edge_src=edge_src, edge_w=edge_w,
         nnz=nnz.astype(np.int64), row_valid=row_valid,
-        symmetric=_check_symmetric(a), **split, **ell,
+        symmetric=_check_symmetric(a), row_order=row_order, **split, **ell,
     )

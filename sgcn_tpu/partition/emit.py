@@ -78,7 +78,9 @@ def write_rank_files(outdir: str, a: sp.spmatrix,
     y = sp.coo_matrix(y)
     n = a.shape[0]
     pv = np.asarray(pv, dtype=np.int64)
-    plan = build_comm_plan(sp.csr_matrix(a), pv, k)
+    # id row order: the .r text formats assume local index == rank by
+    # ascending global id within the part (Parallel-GCN reader contract)
+    plan = build_comm_plan(sp.csr_matrix(a), pv, k, row_order="id")
     # local_idx ranks vertices by global id within each part, so owned[r]
     # (ascending global ids of r's vertices) maps local index -> global id
     owned = [np.where(pv == r)[0] for r in range(k)]

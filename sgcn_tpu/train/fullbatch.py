@@ -53,8 +53,12 @@ from ..utils.stats import CommStats
 # symmetric Â (split COO otherwise); GAT the combined edge list its
 # edge-softmax needs.
 MODELS = {
-    "gcn": (init_gcn_params, gcn_forward_local, gcn_plan_fields),
-    "gat": (init_gat_params, gat_forward_local, lambda plan: GAT_PLAN_FIELDS),
+    # name -> (init, forward, plan->shipped array fields, plan->static kwargs)
+    "gcn": (init_gcn_params, gcn_forward_local, gcn_plan_fields,
+            lambda plan: ({"ell_buckets": plan.ell_buckets}
+                          if plan.symmetric else {})),
+    "gat": (init_gat_params, gat_forward_local, lambda plan: GAT_PLAN_FIELDS,
+            lambda plan: {}),
 }
 
 # loss registry: 'xent' is the torch stack's log-softmax+NLL
@@ -142,8 +146,9 @@ class FullBatchTrainer:
         self.final_activation = final_activation
         self.compute_dtype = compute_dtype
         self.remat = remat
-        init_fn, self._forward_fn, fields_fn = MODELS[model]
+        init_fn, self._forward_fn, fields_fn, static_fn = MODELS[model]
         self.plan_fields = fields_fn(plan)
+        self._fwd_static = static_fn(plan)   # e.g. the ELL bucket structure
         self.model = model
         self.loss_name = loss
         self._loss_fn = LOSSES[loss]
@@ -174,6 +179,7 @@ class FullBatchTrainer:
             activation=self.activation,
             final_activation=self.final_activation,
             symmetric=self.plan.symmetric,
+            **self._fwd_static,
         )
         return out.astype("float32")
 

@@ -29,7 +29,7 @@ import optax
 import scipy.sparse as sp
 
 from ..parallel.mesh import make_mesh_1d, shard_stacked
-from ..parallel.plan import build_comm_plan, pad_comm_plan
+from ..parallel.plan import build_comm_plan, pad_comm_plan, shared_ell_buckets
 from ..utils.stats import CommStats
 from .fullbatch import (FullBatchTrainer, TrainData, _plan_arrays,
                         make_train_data)
@@ -101,8 +101,9 @@ class MiniBatchTrainer:
             # batch misses some part entirely
             raw.append(build_comm_plan(sub, pv, k, pad_rows_to=pad_rows_to))
         env = tuple(max(getattr(p, f) for p in raw)
-                    for f in ("b", "s", "r", "e", "el", "eh", "ell_k", "tl"))
-        self.plans = [pad_comm_plan(p, *env) for p in raw]
+                    for f in ("b", "s", "r", "e", "el", "eh", "tl"))
+        shared = shared_ell_buckets(raw, env[0])
+        self.plans = [pad_comm_plan(p, *env, ell_buckets=shared) for p in raw]
         # one compiled step serves every batch, so the symmetric fast path is
         # only safe if every batch plan is symmetric (sampled subgraphs of a
         # symmetric graph are, but keep the guard exact)

@@ -47,9 +47,13 @@ def test_halo_matches_bruteforce(ahat):
         for q in range(k):
             cnt = plan.send_counts[q, p]
             if cnt:
-                # local indices on q → recover global ids
+                # local indices on q → recover global ids via the plan's own
+                # inverse relabeling (row_order='degree' means local rank is
+                # NOT global-id rank)
                 owned_q = np.where(pv == q)[0]
-                got.extend(owned_q[plan.send_idx[q, p, :cnt]])
+                l2g = np.full(plan.b, -1, dtype=np.int64)
+                l2g[plan.local_idx[owned_q]] = owned_q
+                got.extend(l2g[plan.send_idx[q, p, :cnt]])
         np.testing.assert_array_equal(np.sort(got), expected)
 
 

@@ -144,7 +144,7 @@ def test_ell_sym_forward_parity(ahat, k):
 
     def per_chip(pa, h):
         pa = jax.tree.map(lambda x: x[0], pa)
-        return pspmm_ell_sym(h[0], *_sym_args(pa))[None]
+        return pspmm_ell_sym(h[0], *_sym_args(pa), plan.ell_buckets)[None]
 
     fn = jax.jit(jax.shard_map(per_chip, mesh=mesh,
                                in_specs=(P("v"), P("v")), out_specs=P("v")))
@@ -172,7 +172,7 @@ def test_ell_sym_backward_parity(ahat):
         pa = jax.tree.map(lambda x: x[0], pa)
 
         def obj(hl):
-            out = pspmm_ell_sym(hl, *_sym_args(pa))
+            out = pspmm_ell_sym(hl, *_sym_args(pa), plan.ell_buckets)
             return jax.lax.psum(jnp.sum(out * w[0]), "v")
 
         return jax.grad(obj)(h[0])[None]
