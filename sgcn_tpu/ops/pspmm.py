@@ -119,14 +119,15 @@ def spmm_ell(ell_idx, ell_w, tail_dst, tail_src, tail_w, h, buckets):
 
     ``buckets = ((nb, wb), ...)`` is the plan's static degree-bucket
     structure (``sgcn_tpu.parallel.plan``): the next ``nb`` output rows each
-    own ``wb`` flat slots of ``ell_idx``/``ell_w``.  Per bucket this is one
-    2D-index gather + dense weighted width-reduce — XLA emits the gather
-    producing ``(nb, wb, f)`` directly (a flat-index + reshape form forced
-    physical relayouts of the whole gathered block, ~30 ms/epoch of "data
-    formatting" at ogbn-arxiv scale in the round-3 trace), and the einsum
-    fuses into the gather consumer.  The v5e gather is row-rate-bound
-    (~350-400 Mrows/s, pattern/dtype-independent), so the bucketed layout's
-    ~1.1-1.2× padding vs single-width ELL's ~1.7× is a direct time saving.
+    own ``wb`` flat slots of ``ell_idx``/``ell_w``, stored WIDTH-MAJOR (slot
+    t of the bucket's rows is one contiguous (nb,) run).  Per slot this is
+    one fused gather·weight + accumulate — no (nb, wb, f) intermediate
+    exists, which is the point: the row-major gather+reduce form paid
+    ~17 ms/epoch of XLA "data formatting" relayouts at ogbn-arxiv scale
+    (round-3 trace), and the unrolled per-slot form measured 444 vs 367
+    Mrows/s isolated.  The v5e gather is row-rate-bound (pattern/dtype-
+    independent), so the bucketed layout's ~1.1-1.2× padding vs
+    single-width ELL's ~1.7× is a direct time saving.
     """
     if sum(nb * wb for nb, wb in buckets) != ell_idx.shape[0]:
         raise ValueError(
@@ -135,10 +136,12 @@ def spmm_ell(ell_idx, ell_w, tail_dst, tail_src, tail_w, h, buckets):
     outs = []
     off = 0
     for nb, wb in buckets:
-        idx = ell_idx[off: off + nb * wb].reshape(nb, wb)
-        wv = ell_w[off: off + nb * wb].reshape(nb, wb)
-        g = jnp.take(h, idx, axis=0)                   # (nb, wb, f)
-        outs.append(jnp.einsum("nkf,nk->nf", g, wv))
+        acc = None
+        for t in range(wb):
+            seg = slice(off + t * nb, off + (t + 1) * nb)
+            g = jnp.take(h, ell_idx[seg], axis=0) * ell_w[seg][:, None]
+            acc = g if acc is None else acc + g
+        outs.append(acc)
         off += nb * wb
     out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
     tg = jnp.take(h, tail_src, axis=0) * tail_w[:, None]
