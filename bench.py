@@ -33,7 +33,7 @@ def synth_graph(n: int, avg_deg: int, seed: int = 0) -> sp.csr_matrix:
     return er_graph(n, avg_deg, seed)
 
 
-def bench_jax(ahat, feats, labels, widths, epochs: int):
+def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn"):
     import jax
 
     # The axon sitecustomize pre-registers the TPU plugin at interpreter
@@ -61,7 +61,11 @@ def bench_jax(ahat, feats, labels, widths, epochs: int):
     part_metrics["comm_volume_rows"] = int(plan.predicted_send_volume.sum())
     part_metrics["comm_messages"] = int(plan.predicted_message_count.sum())
     mesh = make_mesh_1d(k)
-    trainer = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths, mesh=mesh)
+    # PGAT semantics: bare stacked modules, no inter-layer activation
+    # (GPU/PGAT.py:202-213; same default as the trainer CLI)
+    kw = {"model": "gat", "activation": "none"} if model == "gat" else {}
+    trainer = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths,
+                               mesh=mesh, **kw)
     data = make_train_data(plan, feats, labels)
     data = type(data)(**shard_stacked(mesh, vars(data)))
     # DIFFERENTIAL timing (round-3 protocol): this box reaches its chip
@@ -246,6 +250,10 @@ def main() -> None:
     p.add_argument("--hidden", type=int, default=128)
     p.add_argument("--classes", type=int, default=40)
     p.add_argument("-l", "--layers", type=int, default=3)
+    p.add_argument("--model", default="gcn", choices=["gcn", "gat"],
+                   help="gat = attention-weighted aggregation (PGAT role); "
+                        "torch/dense yardsticks are GCN-shaped, so they are "
+                        "skipped for gat")
     p.add_argument("-e", "--epochs", type=int, default=5)
     p.add_argument("--skip-torch", action="store_true")
     p.add_argument("--skip-vdev", action="store_true",
@@ -263,7 +271,11 @@ def main() -> None:
     labels = rng.integers(0, args.classes, size=args.n).astype(np.int32)
     widths = [args.hidden] * (args.layers - 1) + [args.classes]
 
-    epoch_s, part_metrics = bench_jax(ahat, feats, labels, widths, args.epochs)
+    epoch_s, part_metrics = bench_jax(ahat, feats, labels, widths, args.epochs,
+                                      model=args.model)
+    if args.model == "gat":
+        args.skip_torch = True          # yardsticks below are GCN-shaped
+        args.skip_vdev = True
     # two honest yardsticks (VERDICT r2 weak #2/#6): the reference-style torch
     # CPU stack (kept, as vs_torch_cpu) and the dense-matmul roofline epoch at
     # identical shapes (epoch_vs_dense >= 1; 1.0 = sparse path at MXU parity).
@@ -271,7 +283,7 @@ def main() -> None:
     # the single-chip run — on a multi-chip mesh it would conflate parallel
     # speedup with gather efficiency; emit null there.
     import jax as _jax
-    single = len(_jax.devices()) == 1
+    single = len(_jax.devices()) == 1 and args.model == "gcn"
     dense_s = bench_dense_equiv(args.n, args.f, widths, args.epochs) \
         if single else None
     if args.skip_torch:
@@ -285,7 +297,7 @@ def main() -> None:
         vdev_metrics = bench_vdev_partitioned(
             args.vdev_n, args.avg_deg, args.f, widths, max(2, args.epochs // 2))
     print(json.dumps({
-        "metric": "fullbatch_gcn_epoch_time",
+        "metric": f"fullbatch_{args.model}_epoch_time",
         "value": round(epoch_s, 6),
         "unit": "s",
         "vs_baseline": vs,

@@ -30,8 +30,10 @@ from ..ops.pspmm import halo_exchange
 from ..parallel.mesh import AXIS
 from .activations import get_activation
 
-# plan arrays the GAT forward consumes (fullbatch ships exactly these)
-GAT_PLAN_FIELDS = ("send_idx", "halo_src", "edge_dst", "edge_src", "edge_w")
+# plan arrays the GAT forward consumes (fullbatch ships exactly these):
+# the bucketed combined-edge layout plus its hub tail
+GAT_PLAN_FIELDS = ("send_idx", "halo_src", "cell_idx", "cell_w",
+                   "ctail_dst", "ctail_src", "ctail_w")
 
 _NEG = -1e30
 
@@ -60,8 +62,10 @@ def init_gat_params(rng: jax.Array, dims: list[tuple[int, int]]):
 def edge_softmax(scores, edge_mask, edge_dst, num_rows: int):
     """Numerically-stable softmax over incoming edges of each dst row.
 
-    ``edge_dst`` is sorted (plan invariant); padding edges (mask 0) get -inf
-    scores so they carry zero mass; rows with no real edges produce zeros.
+    Segment-machinery form over a sorted COO edge list — for callers
+    holding plain edge lists; unit-tested against a dense softmax.  The
+    trainer path uses the streaming bucketed form in ``gat_layer_local``
+    (itself parity-tested against the dense GAT oracle).
     """
     scores = jnp.where(edge_mask, scores, _NEG)
     row_max = jax.ops.segment_max(
@@ -77,23 +81,78 @@ def gat_layer_local(
     w, a1, a2,
     h,                            # (B, fin) local rows
     send_idx, halo_src,           # halo plan
-    edge_dst, edge_src, edge_w,   # padded local edge lists (E,)
+    cell_idx, cell_w,             # bucketed combined-edge layout (flat)
+    ctail_dst, ctail_src, ctail_w,  # hub overflow tail (COO)
+    buckets,                      # static ((nb, wb), ...) of cell layout
     axis_name: str = AXIS,
 ):
-    """One sharded GAT layer: project → exchange [Z‖z2] → edge-softmax → aggregate."""
+    """One sharded GAT layer: project → exchange [Z‖z2] → streaming
+    edge-softmax over the bucketed slots → aggregate.
+
+    The attention softmax runs ONLINE (flash-attention style): per width
+    slot t, ONE gather of ``[z_src ‖ z2_src]`` rows feeds both the score and
+    the aggregation, with running max ``m``, denominator ``d`` and weighted
+    accumulator renormalized as larger scores arrive.  This replaces the
+    segment-max/sum/scatter pipeline over a COO edge list (measured 1.15 s
+    vs 0.037 s GCN at ogbn-arxiv scale) with the same per-slot fused
+    gathers the GCN path uses.  Hub rows past the bucket width cap merge
+    their tail edges through a second max/renormalize pass — exact, not
+    approximate.  The v5e gather is row-rate-bound, so fetching the
+    (fout+1)-wide row costs the same as fout; one gather per edge total.
+    """
+    b = h.shape[0]
     z = h @ w                                        # (B, fout)
+    fout = z.shape[-1]
     z1 = z @ a1                                      # (B,)
     z2 = z @ a2                                      # (B,)
     table = jnp.concatenate([z, z2[:, None]], axis=-1)
     halo = halo_exchange(table, send_idx, halo_src, axis_name)
     full = jnp.concatenate([table, halo], axis=0)    # (B+R, fout+1)
-    zt, z2t = full[:, :-1], full[:, -1]
-    mask = edge_w > 0
-    scores = z1[edge_dst] + z2t[edge_src]            # (E,)
-    alpha = edge_softmax(scores, mask, edge_dst, z.shape[0])
-    gathered = zt[edge_src] * alpha[:, None]
-    return jax.ops.segment_sum(
-        gathered, edge_dst, num_segments=z.shape[0], indices_are_sorted=True)
+
+    accs, denoms, maxes = [], [], []
+    off = r0 = 0
+    for nb, wb in buckets:
+        z1b = jax.lax.slice_in_dim(z1, r0, r0 + nb)
+        m = jnp.full((nb,), _NEG, jnp.float32)
+        d = jnp.zeros((nb,), jnp.float32)
+        acc = jnp.zeros((nb, fout), jnp.float32)
+        for t in range(wb):
+            seg = slice(off + t * nb, off + (t + 1) * nb)
+            g = jnp.take(full, cell_idx[seg], axis=0)   # (nb, fout+1)
+            valid = cell_w[seg] > 0
+            s = jnp.where(valid, z1b + g[:, -1], _NEG)
+            m2 = jnp.maximum(m, s)
+            scale = jnp.exp(m - m2)                  # 0 while m = -inf
+            e = jnp.where(valid, jnp.exp(s - m2), 0.0)
+            acc = acc * scale[:, None] + e[:, None] * g[:, :-1]
+            d = d * scale + e
+            m = m2
+        accs.append(acc)
+        denoms.append(d)
+        maxes.append(m)
+        off += nb * wb
+        r0 += nb
+    acc = accs[0] if len(accs) == 1 else jnp.concatenate(accs, axis=0)
+    d = denoms[0] if len(denoms) == 1 else jnp.concatenate(denoms)
+    m = maxes[0] if len(maxes) == 1 else jnp.concatenate(maxes)
+
+    # fold the hub tail into the same softmax: global row max first, then
+    # rescale the streamed partials and add the tail's exp mass
+    tvalid = ctail_w > 0
+    ts = jnp.where(tvalid, z1[ctail_dst] + full[ctail_src, -1], _NEG)
+    tmax = jax.ops.segment_max(ts, ctail_dst, num_segments=b,
+                               indices_are_sorted=True)
+    mg = jnp.maximum(m, jnp.maximum(tmax, _NEG))
+    # empty rows (m = mg = _NEG) get rescale = exp(0) = 1, harmless
+    # because their acc and d are both exactly 0
+    rescale = jnp.exp(m - mg)
+    acc = acc * rescale[:, None]
+    d = d * rescale
+    te = jnp.where(tvalid, jnp.exp(ts - mg[ctail_dst]), 0.0)
+    d = d + jax.ops.segment_sum(te, ctail_dst, num_segments=b,
+                                indices_are_sorted=True)
+    acc = acc.at[ctail_dst].add(te[:, None] * full[ctail_src, :-1])
+    return acc / (d + 1e-9)[:, None]
 
 
 def gat_forward_local(
@@ -104,6 +163,7 @@ def gat_forward_local(
     final_activation: str = "none",
     symmetric: bool = False,      # accepted for interface parity; attention
                                   # weights are never symmetric, so unused
+    cell_buckets: tuple | None = None,   # static plan.cell_buckets
     axis_name: str = AXIS,
 ):
     """Per-chip forward: stacked GAT layers.
@@ -112,10 +172,13 @@ def gat_forward_local(
     (softmax-weighted aggregation is the nonlinearity, ``GPU/PGAT.py:202-213``);
     ``activation='elu'`` gives the standard GAT variant.
 
-    GAT keeps the combined ``[local; halo]`` edge list (not the split
-    overlap form): the edge-softmax normalizes each row over local AND halo
-    edges together, so the aggregation genuinely depends on the exchange.
+    GAT streams the combined ``[local; halo]`` bucketed edge layout (not the
+    split overlap form): the edge-softmax normalizes each row over local AND
+    halo edges together, so the aggregation genuinely depends on the
+    exchange.
     """
+    if cell_buckets is None:
+        raise ValueError("GAT forward needs the plan's static cell_buckets")
     act = get_activation(activation)
     fact = get_activation(final_activation)
     nl = len(params)
@@ -123,7 +186,8 @@ def gat_forward_local(
         h = gat_layer_local(
             p["w"], p["a1"], p["a2"], h,
             pa["send_idx"], pa["halo_src"],
-            pa["edge_dst"], pa["edge_src"], pa["edge_w"],
-            axis_name=axis_name)
+            pa["cell_idx"], pa["cell_w"],
+            pa["ctail_dst"], pa["ctail_src"], pa["ctail_w"],
+            cell_buckets, axis_name=axis_name)
         h = fact(h) if i == nl - 1 else act(h)
     return h

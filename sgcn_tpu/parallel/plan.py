@@ -122,6 +122,35 @@ class CommPlan:
     # Parallel-GCN/main.c:374-404).
     symmetric: bool
 
+    # The COMBINED edge list (src in [0, B+R), local ‖ halo) in the same
+    # bucketed width-major layout — for ops that must see every in-edge of a
+    # row at once: the GAT edge-softmax normalizes over local AND halo
+    # neighbors together, so it streams these slots with an online-softmax
+    # (running max / denominator) instead of segment machinery.  Built
+    # LAZILY (``ensure_cell()``) — only the GAT model ships these arrays,
+    # and they duplicate the edge storage.
+    ctl: int | None = None            # padded combined-tail length
+    cell_buckets: tuple | None = None  # ((nb, wb), ...) static structure
+    cell_idx: np.ndarray | None = None   # (k, CET) int32 flat src
+    cell_w: np.ndarray | None = None     # (k, CET) float32, 0 on padding
+    ctail_dst: np.ndarray | None = None  # (k, CTL) int32
+    ctail_src: np.ndarray | None = None  # (k, CTL) int32
+    ctail_w: np.ndarray | None = None    # (k, CTL) float32, 0 on padding
+    ctail_nnz: np.ndarray | None = None  # (k,) true combined-tail nnz
+
+    def ensure_cell(self, buckets: tuple | None = None,
+                    ctl: int | None = None) -> "CommPlan":
+        """Build the combined-edge bucketed layout on first use (GAT)."""
+        if (self.cell_buckets is None
+                or buckets not in (None, self.cell_buckets)
+                or (ctl is not None and ctl != self.ctl)):
+            fields = _cell_fields(_build_ell(
+                self.edge_dst, self.edge_src, self.edge_w, self.nnz, self.b,
+                row_order=self.row_order, buckets=buckets, tl=ctl))
+            for name, val in fields.items():
+                setattr(self, name, val)
+        return self
+
     # ------------------------------------------------------------------ stats
     @property
     def predicted_send_volume(self) -> np.ndarray:
@@ -260,6 +289,15 @@ def _choose_buckets(profile: np.ndarray, max_buckets: int = 6,
         keep = np.unique(np.linspace(0, len(cuts) - 1, 65).astype(int))
         cuts = [cuts[i] for i in keep]
     m = len(cuts)
+    # bucket width = MAX degree inside the segment (profiles are descending
+    # for the local-degree relabel key, but only near-descending for e.g.
+    # the combined local+halo degree — take the true segment max, not d[start])
+    segmax = [[0] * m for _ in range(m)]
+    for i in range(m - 1):
+        run = 0
+        for j in range(i + 1, m):
+            run = max(run, int(d[cuts[j - 1]: cuts[j]].max()))
+            segmax[i][j] = run
     inf = float("inf")
     best = [[inf] * (max_buckets + 1) for _ in range(m)]
     back = [[0] * (max_buckets + 1) for _ in range(m)]
@@ -269,7 +307,7 @@ def _choose_buckets(profile: np.ndarray, max_buckets: int = 6,
             for i in range(j):
                 if best[i][q - 1] == inf:
                     continue
-                w = max(int(d[cuts[i]]), 1)
+                w = max(segmax[i][j], 1)
                 c = best[i][q - 1] + (cuts[j] - cuts[i]) * w
                 if c < best[j][q]:
                     best[j][q] = c
@@ -279,7 +317,7 @@ def _choose_buckets(profile: np.ndarray, max_buckets: int = 6,
     j = m - 1
     while j > 0:
         i = back[j][q]
-        segs.append((cuts[j] - cuts[i], max(int(d[cuts[i]]), 1)))
+        segs.append((cuts[j] - cuts[i], max(segmax[i][j], 1)))
         j, q = i, q - 1
     return tuple(reversed(segs))
 
@@ -378,18 +416,31 @@ def _build_ell(ledge_dst, ledge_src, ledge_w, lnnz, b,
                 ltail_nnz=ltail_nnz)
 
 
-def shared_ell_buckets(plans: list, b: int) -> tuple:
+def shared_ell_buckets(plans: list, b: int, combined: bool = False) -> tuple:
     """Bucket structure covering every plan's degree profile — the shared
     compiled-envelope companion to ``pad_comm_plan`` for mini-batch plans
-    (all padded to ``b`` rows)."""
+    (all padded to ``b`` rows).  ``combined=True`` covers the combined
+    local+halo edge lists (the GAT layout) instead of the local-src ones."""
     prof = np.zeros(b, dtype=np.int64)
     for pl in plans:
-        q = ell_degree_profile(pl.ledge_dst, pl.lnnz, pl.b)
+        q = (ell_degree_profile(pl.edge_dst, pl.nnz, pl.b) if combined
+             else ell_degree_profile(pl.ledge_dst, pl.lnnz, pl.b))
         np.maximum(prof[: pl.b], q, out=prof[: pl.b])
     if all(pl.row_order == "degree" for pl in plans):
         return _choose_buckets(prof)
     # id-ordered rows: one classic tail-bounded width shared by all
+    if combined:
+        return ((b, max(max(wb for _, wb in pl.ensure_cell().cell_buckets)
+                        for pl in plans)),)
     return ((b, max(pl.ell_k for pl in plans)),)
+
+
+def _cell_fields(ell: dict) -> dict:
+    """Rename a ``_build_ell`` result into the combined-edge field names."""
+    return dict(ctl=ell["tl"], cell_buckets=ell["ell_buckets"],
+                cell_idx=ell["ell_idx"], cell_w=ell["ell_w"],
+                ctail_dst=ell["ltail_dst"], ctail_src=ell["ltail_src"],
+                ctail_w=ell["ltail_w"], ctail_nnz=ell["ltail_nnz"])
 
 
 def _check_symmetric(a: sp.spmatrix) -> bool:
@@ -446,14 +497,19 @@ def relabel_plan(a: sp.spmatrix, partvec: np.ndarray, k: int,
         ell_idx=z((k, b), np.int32), ell_w=z((k, b), np.float32),
         ltail_dst=z((k, 1), np.int32), ltail_src=z((k, 1), np.int32),
         ltail_w=z((k, 1), np.float32), ltail_nnz=z(k, np.int64),
+        ctl=1, cell_buckets=((b, 1),),
+        cell_idx=z((k, b), np.int32), cell_w=z((k, b), np.float32),
+        ctail_dst=z((k, 1), np.int32), ctail_src=z((k, 1), np.int32),
+        ctail_w=z((k, 1), np.float32), ctail_nnz=z(k, np.int64),
         symmetric=_check_symmetric(a), row_order="id",
     )
 
 
 def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
                   el: int | None = None, eh: int | None = None,
-                  tl: int | None = None,
-                  ell_buckets: tuple | None = None) -> CommPlan:
+                  tl: int | None = None, ctl: int | None = None,
+                  ell_buckets: tuple | None = None,
+                  cell_buckets: tuple | None = None) -> CommPlan:
     """Re-pad a plan to a larger (B, S, R, E) envelope.
 
     Lets many plans (one per mini-batch) share ONE compiled train step: the
@@ -469,12 +525,17 @@ def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
     el = plan.el if el is None else el
     eh = plan.eh if eh is None else eh
     tl = plan.tl if tl is None else tl
+    if ctl is None:
+        ctl = plan.ctl
     if (b, s, r, e, el, eh, tl) == (
             plan.b, plan.s, plan.r, plan.e, plan.el, plan.eh, plan.tl) \
-            and ell_buckets in (None, plan.ell_buckets):
+            and ctl == plan.ctl \
+            and ell_buckets in (None, plan.ell_buckets) \
+            and cell_buckets in (None, plan.cell_buckets):
         return plan
     if (b < plan.b or s < plan.s or r < plan.r or e < plan.e
-            or el < plan.el or eh < plan.eh or tl < plan.tl):
+            or el < plan.el or eh < plan.eh or tl < plan.tl
+            or (ctl is not None and plan.ctl is not None and ctl < plan.ctl)):
         raise ValueError("pad_comm_plan cannot shrink an envelope")
     k = plan.k
 
@@ -504,15 +565,19 @@ def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
     ell = _build_ell(split["ledge_dst"], split["ledge_src"], split["ledge_w"],
                      split["lnnz"], b, row_order=plan.row_order,
                      buckets=ell_buckets, tl=tl)
-    return CommPlan(
+    padded = CommPlan(
         n=plan.n, k=k, b=b, s=s, r=r, e=e,
         owner=plan.owner, local_idx=plan.local_idx, part_sizes=plan.part_sizes,
         send_idx=send_idx, send_counts=plan.send_counts.copy(),
         halo_src=halo_src, halo_counts=plan.halo_counts.copy(),
         edge_dst=edge_dst, edge_src=edge_src, edge_w=edge_w,
         nnz=plan.nnz.copy(), row_valid=row_valid,
-        symmetric=plan.symmetric, row_order=plan.row_order, **split, **ell,
+        symmetric=plan.symmetric, row_order=plan.row_order,
+        **split, **ell,
     )
+    if cell_buckets is not None or plan.cell_buckets is not None:
+        padded.ensure_cell(buckets=cell_buckets, ctl=ctl)
+    return padded
 
 
 def build_comm_plan(
@@ -637,5 +702,6 @@ def build_comm_plan(
         halo_src=halo_src, halo_counts=halo_counts,
         edge_dst=edge_dst, edge_src=edge_src, edge_w=edge_w,
         nnz=nnz.astype(np.int64), row_valid=row_valid,
-        symmetric=_check_symmetric(a), row_order=row_order, **split, **ell,
+        symmetric=_check_symmetric(a), row_order=row_order,
+        **split, **ell,
     )

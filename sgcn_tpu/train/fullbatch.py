@@ -58,7 +58,9 @@ MODELS = {
             lambda plan: ({"ell_buckets": plan.ell_buckets}
                           if plan.symmetric else {})),
     "gat": (init_gat_params, gat_forward_local, lambda plan: GAT_PLAN_FIELDS,
-            lambda plan: {}),
+            # ensure_cell: the combined-edge layout is built lazily — only
+            # GAT ships it, and it duplicates the edge storage
+            lambda plan: {"cell_buckets": plan.ensure_cell().cell_buckets}),
 }
 
 # loss registry: 'xent' is the torch stack's log-softmax+NLL

@@ -103,7 +103,17 @@ class MiniBatchTrainer:
         env = tuple(max(getattr(p, f) for p in raw)
                     for f in ("b", "s", "r", "e", "el", "eh", "tl"))
         shared = shared_ell_buckets(raw, env[0])
-        self.plans = [pad_comm_plan(p, *env, ell_buckets=shared) for p in raw]
+        # the combined-edge (GAT) layout is lazy; force a SHARED structure
+        # across batch plans only when the model will ship it
+        cshared = (shared_ell_buckets(raw, env[0], combined=True)
+                   if model == "gat" else None)
+        self.plans = [pad_comm_plan(p, *env, ell_buckets=shared,
+                                    cell_buckets=cshared) for p in raw]
+        if model == "gat":
+            # the shared envelope must also share the combined-tail length
+            ctl_max = max(p.ctl for p in self.plans)
+            for p in self.plans:
+                p.ensure_cell(buckets=cshared, ctl=ctl_max)
         # one compiled step serves every batch, so the symmetric fast path is
         # only safe if every batch plan is symmetric (sampled subgraphs of a
         # symmetric graph are, but keep the guard exact)

@@ -65,3 +65,25 @@ def test_gat_params_shapes():
     assert params[0]["w"].shape == (12, 8)
     assert params[0]["a1"].shape == (8,)
     assert params[1]["a2"].shape == (4,)
+
+
+def test_edge_softmax_matches_dense():
+    """The COO-edge-list softmax helper must equal a dense masked softmax."""
+    import jax.numpy as jnp
+    from sgcn_tpu.models.gat import edge_softmax
+    rng = np.random.default_rng(5)
+    n, deg = 12, 4
+    dst = np.repeat(np.arange(n), deg).astype(np.int32)
+    src = rng.integers(0, n, size=n * deg).astype(np.int32)
+    scores = rng.standard_normal(n * deg).astype(np.float32)
+    mask = rng.random(n * deg) < 0.8          # some padding edges
+    alpha = np.asarray(edge_softmax(jnp.asarray(scores), jnp.asarray(mask),
+                                    jnp.asarray(dst), n))
+    dense = np.full((n, n * deg), -np.inf)
+    dense[dst[mask], np.arange(n * deg)[mask]] = scores[mask]
+    with np.errstate(invalid="ignore"):
+        ref = np.exp(dense - dense.max(axis=1, keepdims=True))
+        ref = np.nan_to_num(ref / np.maximum(ref.sum(axis=1, keepdims=True),
+                                             1e-9))
+    np.testing.assert_allclose(alpha, ref[dst, np.arange(n * deg)],
+                               rtol=1e-5, atol=1e-6)
