@@ -23,6 +23,8 @@ Per-chip code, meant to run inside ``shard_map`` over the 1D vertex mesh.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -33,7 +35,7 @@ from .activations import get_activation
 # plan arrays the GAT forward consumes (fullbatch ships exactly these):
 # the bucketed combined-edge layout plus its hub tail
 GAT_PLAN_FIELDS = ("send_idx", "halo_src", "cell_idx", "cell_w",
-                   "ctail_dst", "ctail_src", "ctail_w")
+                   "ctail_dst", "ctail_src", "ctail_w", "row_valid")
 
 _NEG = -1e30
 
@@ -77,34 +79,12 @@ def edge_softmax(scores, edge_mask, edge_dst, num_rows: int):
     return ex / (denom[edge_dst] + 1e-9)
 
 
-def gat_layer_local(
-    w, a1, a2,
-    h,                            # (B, fin) local rows
-    send_idx, halo_src,           # halo plan
-    cell_idx, cell_w,             # bucketed combined-edge layout (flat)
-    ctail_dst, ctail_src, ctail_w,  # hub overflow tail (COO)
-    buckets,                      # static ((nb, wb), ...) of cell layout
-    axis_name: str = AXIS,
-):
-    """One sharded GAT layer: project → exchange [Z‖z2] → streaming
-    edge-softmax over the bucketed slots → aggregate.
-
-    The attention softmax runs ONLINE (flash-attention style): per width
-    slot t, ONE gather of ``[z_src ‖ z2_src]`` rows feeds both the score and
-    the aggregation, with running max ``m``, denominator ``d`` and weighted
-    accumulator renormalized as larger scores arrive.  This replaces the
-    segment-max/sum/scatter pipeline over a COO edge list (measured 1.15 s
-    vs 0.037 s GCN at ogbn-arxiv scale) with the same per-slot fused
-    gathers the GCN path uses.  Hub rows past the bucket width cap merge
-    their tail edges through a second max/renormalize pass — exact, not
-    approximate.  The v5e gather is row-rate-bound, so fetching the
-    (fout+1)-wide row costs the same as fout; one gather per edge total.
-    """
-    b = h.shape[0]
-    z = h @ w                                        # (B, fout)
+def _gat_stream(z, z1, z2, send_idx, halo_src, cell_idx, cell_w,
+                ctail_dst, ctail_src, ctail_w, buckets, axis_name):
+    """Streaming online-softmax attention core (general edge patterns —
+    autodiff provides the backward); returns the aggregated rows."""
+    b = z.shape[0]
     fout = z.shape[-1]
-    z1 = z @ a1                                      # (B,)
-    z2 = z @ a2                                      # (B,)
     table = jnp.concatenate([z, z2[:, None]], axis=-1)
     halo = halo_exchange(table, send_idx, halo_src, axis_name)
     full = jnp.concatenate([table, halo], axis=0)    # (B+R, fout+1)
@@ -155,14 +135,203 @@ def gat_layer_local(
     return acc / (d + 1e-9)[:, None]
 
 
+def gat_layer_local(
+    w, a1, a2,
+    h,                            # (B, fin) local rows
+    send_idx, halo_src,           # halo plan
+    cell_idx, cell_w,             # bucketed combined-edge layout (flat)
+    ctail_dst, ctail_src, ctail_w,  # hub overflow tail (COO)
+    row_valid=None,               # (B,) 1/0 — unused here (per-row max)
+    buckets=((1, 1),),            # static ((nb, wb), ...) of cell layout
+    axis_name: str = AXIS,
+):
+    """One sharded GAT layer: project → exchange [Z‖z2] → streaming
+    edge-softmax over the bucketed slots → aggregate.
+
+    The attention softmax runs ONLINE (flash-attention style): per width
+    slot t, ONE gather of ``[z_src ‖ z2_src]`` rows feeds both the score and
+    the aggregation, with running max ``m``, denominator ``d`` and weighted
+    accumulator renormalized as larger scores arrive.  This replaces the
+    segment-max/sum/scatter pipeline over a COO edge list (measured 1.15 s
+    vs 0.037 s GCN at ogbn-arxiv scale) with the same per-slot fused
+    gathers the GCN path uses.  Hub rows past the bucket width cap merge
+    their tail edges through a second max/renormalize pass — exact, not
+    approximate.  The v5e gather is row-rate-bound, so fetching the
+    (fout+1)-wide row costs the same as fout; one gather per edge total.
+    """
+    z = h @ w                                        # (B, fout)
+    z1 = z @ a1                                      # (B,)
+    z2 = z @ a2                                      # (B,)
+    return _gat_stream(z, z1, z2, send_idx, halo_src, cell_idx, cell_w,
+                       ctail_dst, ctail_src, ctail_w, buckets, axis_name)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(12, 13))
+def gat_layer_sym(w, a1, a2, h, send_idx, halo_src, cell_idx, cell_w,
+                  ctail_dst, ctail_src, ctail_w, row_valid, buckets,
+                  axis_name=AXIS):
+    """``gat_layer_local`` in FACTORIZED form with a gather-only backward,
+    for SYMMETRIC edge patterns (undirected graphs — the standing case).
+
+    Two algebraic facts reshape the whole layer:
+
+      * ``s_ij = z1_i + z2_j`` is SHIFT-INVARIANT under the row softmax: any
+        per-row constant cancels, so ``z1``/``a1`` do not affect the output
+        at all (``∂L/∂a1 = 0`` exactly; the reference's PGAT shares this —
+        no LeakyReLU between the additive scores and the softmax,
+        ``GPU/PGAT.py:137-150``) and α factorizes per SOURCE:
+        ``α_ij = u_j / Σ_{j'∈N(i)} u_j'`` with ``u_j = exp(z2_j − C)``.
+        The layer is exactly ``out_i = (Σ_j u_j z_j) / (Σ_j u_j)`` — two
+        mask-weighted aggregations over the bucketed slots, both gathering
+        128-lane rows (the v5e gather drops 3.2× the moment a row exceeds
+        one 128-lane tile, so numerator rows ``u·z`` and a lane-broadcast
+        denominator table are kept exactly 128 wide; the denominator pass
+        row-sums its gathered tile, which also keeps XLA from narrowing the
+        gather).  ``C`` is the global max of ``z2`` (one pmax): exact
+        stabilization for score spreads < ~80 nats — beyond that f32
+        attention is degenerate under ANY stabilization;
+
+      * for a symmetric pattern, row ``j``'s in-edge slots enumerate exactly
+        the rows ``i`` that aggregate ``j``, so the backward transposes
+        ``N = P·(u z), D = P·u`` into the SAME gather passes over the
+        exchanged ``[ḡ/D ‖ −(ḡ·out)/D]`` table — no scatter, no sort, and
+        the halo's backward contribution arrives through a forward-style
+        exchange (measured: autodiff's scatter transpose was ~223 ms of the
+        320 ms online-softmax GAT epoch at ogbn-arxiv scale; this form
+        benches 0.062 s).
+    """
+    out, _, _, _ = _gat_factored_fwd_core(
+        w, a2, h, send_idx, halo_src, cell_idx, cell_w,
+        ctail_dst, ctail_src, ctail_w, row_valid, buckets, axis_name)
+    return out
+
+
+def _mask_slot_pass(table_f, table_b, cell_idx, cell_w, ctail_dst, ctail_src,
+                    ctail_w, buckets, b):
+    """Shared aggregation core: Σ over in-edge slots of ``mask·table_f[src]``
+    (feature rows) and ``mask·table_b[src]`` (lane-broadcast scalar rows,
+    consumed by row-sum), plus the hub tail via segment ops.
+
+    Returns ``(N, D)``: (b, f) feature sums and (b,) scalar sums.
+    """
+    fout = table_f.shape[-1]
+    lanes = table_b.shape[-1]
+    ns, ds = [], []
+    off = 0
+    for nb, wb in buckets:
+        n_acc = jnp.zeros((nb, fout), jnp.float32)
+        d_acc = jnp.zeros((nb,), jnp.float32)
+        for t in range(wb):
+            seg = slice(off + t * nb, off + (t + 1) * nb)
+            idx = cell_idx[seg]
+            mask = (cell_w[seg] > 0).astype(jnp.float32)
+            n_acc = n_acc + jnp.take(table_f, idx, axis=0) * mask[:, None]
+            # row-sum consumes every lane of the broadcast tile: the gather
+            # stays a fast full-tile fetch (slicing one lane would let XLA
+            # narrow it onto the 3.2×-slower sub-tile path)
+            d_acc = d_acc + jnp.take(table_b, idx, axis=0).sum(axis=-1) \
+                * (mask / lanes)
+        ns.append(n_acc)
+        ds.append(d_acc)
+        off += nb * wb
+    n_out = ns[0] if len(ns) == 1 else jnp.concatenate(ns, axis=0)
+    d_out = ds[0] if len(ds) == 1 else jnp.concatenate(ds)
+    tmask = (ctail_w > 0).astype(jnp.float32)
+    tn = jnp.take(table_f, ctail_src, axis=0) * tmask[:, None]
+    n_out = n_out.at[ctail_dst].add(tn)
+    td = jnp.take(table_b, ctail_src, axis=0).sum(axis=-1) * (tmask / lanes)
+    d_out = d_out + jax.ops.segment_sum(td, ctail_dst, num_segments=b,
+                                        indices_are_sorted=True)
+    return n_out, d_out
+
+
+_BCAST_LANES = 128
+
+
+def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
+                           ctail_dst, ctail_src, ctail_w, row_valid, buckets,
+                           axis_name):
+    b = h.shape[0]
+    z = h @ w
+    fout = z.shape[-1]
+    z2 = z @ a2
+    # global stabilizer over REAL rows only: pad rows carry z2 = 0, which
+    # would floor the max at 0 and turn the underflow guard into an absolute
+    # threshold instead of the documented relative-spread limit
+    z2m = jnp.where(row_valid > 0, z2, -jnp.inf)
+    cg = jax.lax.pmax(jnp.max(z2m), axis_name)
+    u = jnp.exp(z2 - cg)                             # (B,) in (0, 1]
+    p = u[:, None] * z                               # (B, fout)
+    table = jnp.concatenate([p, u[:, None]], axis=-1)
+    halo = halo_exchange(table, send_idx, halo_src, axis_name)
+    full_p = jnp.concatenate([p, halo[:, :fout]], axis=0)     # (B+R, fout)
+    full_u = jnp.concatenate([u, halo[:, fout]])              # (B+R,)
+    ub = jnp.broadcast_to(full_u[:, None], (full_u.shape[0], _BCAST_LANES))
+    num, den = _mask_slot_pass(full_p, ub, cell_idx, cell_w, ctail_dst,
+                               ctail_src, ctail_w, buckets, b)
+    # max(den, tiny): u > 0 for every real edge, so this stays exact until
+    # genuine f32 underflow (~68-nat spread); an ABSOLUTE eps would zero
+    # rows whose neighborhoods sit merely ~20 nats below the global max.
+    # 1e-30, not 1e-38: subnormals are flushed to zero on TPU/XLA, so a
+    # sub-`tiny` guard silently becomes max(den, 0) -> 0/0 = NaN
+    out = num / jnp.maximum(den, 1e-30)[:, None]
+    return out, z, u, den
+
+
+def _gat_layer_sym_fwd(w, a1, a2, h, send_idx, halo_src, cell_idx, cell_w,
+                       ctail_dst, ctail_src, ctail_w, row_valid, buckets,
+                       axis_name):
+    out, z, u, den = _gat_factored_fwd_core(
+        w, a2, h, send_idx, halo_src, cell_idx, cell_w,
+        ctail_dst, ctail_src, ctail_w, row_valid, buckets, axis_name)
+    res = (w, a1, a2, h, z, u, den, out, send_idx, halo_src, cell_idx,
+           cell_w, ctail_dst, ctail_src, ctail_w)
+    return out, res
+
+
+def _gat_layer_sym_bwd(buckets, axis_name, res, gbar):
+    (w, a1, a2, h, z, u, den, out, send_idx, halo_src, cell_idx, cell_w,
+     ctail_dst, ctail_src, ctail_w) = res
+    b = h.shape[0]
+    fout = z.shape[-1]
+    # out = N/(D+ε): cotangents of the two aggregations, per dst row
+    dng = jnp.maximum(den, 1e-30)                    # same guard as forward
+    dn = gbar / dng[:, None]                         # (B, fout)
+    dd = -(gbar * out).sum(axis=-1) / dng            # (B,)
+    # transpose of a symmetric pattern = the same aggregation: for src row
+    # j, Σ_i mask_ij·dn_i over j's in-edge slots (aggregators of j)
+    table = jnp.concatenate([dn, dd[:, None]], axis=-1)
+    halo = halo_exchange(table, send_idx, halo_src, axis_name)
+    full_dn = jnp.concatenate([dn, halo[:, :fout]], axis=0)
+    full_dd = jnp.concatenate([dd, halo[:, fout]])
+    ddb = jnp.broadcast_to(full_dd[:, None], (full_dd.shape[0], _BCAST_LANES))
+    dp, du_agg = _mask_slot_pass(full_dn, ddb, cell_idx, cell_w, ctail_dst,
+                                 ctail_src, ctail_w, buckets, b)
+    # p = u·z, u = exp(z2 − C): chain rules (C is a pmax — constant a.e.)
+    dz = u[:, None] * dp
+    du = (dp * z).sum(axis=-1) + du_agg
+    dz2 = u * du
+    dz_total = dz + dz2[:, None] * a2[None, :]
+    dh = dz_total @ w.T
+    dW = h.T @ dz_total
+    da2 = z.T @ dz2
+    da1 = jnp.zeros_like(a1)       # softmax shift-invariance: exactly zero
+    return (dW, da1, da2, dh,
+            None, None, None, None, None, None, None, None)
+
+
+gat_layer_sym.defvjp(_gat_layer_sym_fwd, _gat_layer_sym_bwd)
+
+
 def gat_forward_local(
     params,
     h,
     pa,                           # plan arrays dict (GAT_PLAN_FIELDS)
     activation: str = "none",
     final_activation: str = "none",
-    symmetric: bool = False,      # accepted for interface parity; attention
-                                  # weights are never symmetric, so unused
+    symmetric: bool = False,      # True selects the factored custom-backward
+                                  # layer, which REQUIRES a symmetric edge
+                                  # PATTERN (attention VALUES need not be)
     cell_buckets: tuple | None = None,   # static plan.cell_buckets
     axis_name: str = AXIS,
 ):
@@ -182,12 +351,23 @@ def gat_forward_local(
     act = get_activation(activation)
     fact = get_activation(final_activation)
     nl = len(params)
+    # symmetric edge pattern (undirected graphs): gather-only custom
+    # backward; general pattern: autodiff through the streaming forward
+    layer = gat_layer_sym if symmetric else gat_layer_local
+    if symmetric:
+        # custom_vjp cotangents must carry the same varying-axes type as
+        # the primals; params arrive replicated (unvarying) but the bwd
+        # produces per-chip PARTIAL grads (varying — the trainer completes
+        # them with its psum), so cast the primals to varying first
+        params = [
+            jax.tree.map(lambda x: jax.lax.pcast(x, axis_name, to="varying"),
+                         p) for p in params]
     for i, p in enumerate(params):
-        h = gat_layer_local(
+        h = layer(
             p["w"], p["a1"], p["a2"], h,
             pa["send_idx"], pa["halo_src"],
             pa["cell_idx"], pa["cell_w"],
             pa["ctail_dst"], pa["ctail_src"], pa["ctail_w"],
-            cell_buckets, axis_name=axis_name)
+            pa["row_valid"], cell_buckets, axis_name)
         h = fact(h) if i == nl - 1 else act(h)
     return h

@@ -1,26 +1,25 @@
 """Pallas TPU SpMM kernel (dst-tiled) — the hand-written alternative to the
 XLA gather/segment-sum path in ``sgcn_tpu.ops.pspmm``.
 
-Status and honest measurements (v5e, 2026-07, see also PARITY.md): the graph
-SpMM is the framework's hot op (27 ms vs 5.6 ms dense at ogbn-arxiv scale,
-f=128) and is bound by random HBM row access in the gather.  Measured
-head-to-head:
+Status and honest measurements (v5e; round-3 DIFFERENTIAL protocol — the
+round-1/2 absolute numbers below carried a ~110 ms-per-dispatch tunnel
+constant, see BASELINE.md): the graph SpMM is the framework's hot op and is
+ROW-RATE-bound in XLA's gather (~350–460 Mrows/s regardless of index
+pattern or row dtype; ~655 Mrows/s in-context for the shipped bucketed
+slot-pass form, ~51 % of the 655 GB/s achieved stream ceiling).  Mosaic
+exposes no batched-row DMA and its ``tpu.dynamic_gather`` is single-vreg,
+so a Pallas kernel cannot beat the row rate from HBM; the round-3 speedups
+came from gathering FEWER rows (bucketed width-major ELL, padding 1.71× →
+1.08×, `sgcn_tpu.parallel.plan`).
 
-  * flat ``take`` + sorted ``segment_sum`` (the shipped default) — 27 ms at
-    n=169k; dst-tiled vmap/scan reformulations of the same math in pure XLA
-    are slower (33 / 44 ms);
-  * this Pallas kernel holds the whole feature table VMEM-resident and
-    accumulates per edge from SMEM-prefetched indices.  Where the table fits
-    VMEM (≈ a few MB, n≈2k at f=128 on v5e) it measured ~1.3× faster than
-    the XLA path (14.1 vs 18.6 ms, interleaved, congested chip); beyond VMEM
-    the Mosaic compile fails, so `spmm_pallas` is opt-in, not the default.
-
-So per SURVEY.md §7.1 ("Pallas kernel only if BCOO SpMM is the bottleneck"):
-it IS the bottleneck, but at full scale the limiting resource is HBM random
-access, which XLA's gather already saturates.  The kernel is kept as a
-first-class, tested op (interpret-mode CI + TPU parity): the starting point
-for per-chip blocks small enough to pin in VMEM — which is exactly what
-k-way partitioning produces as k grows — and for future HBM-table variants.
+This kernel holds the whole feature table VMEM-resident and accumulates per
+edge from SMEM-prefetched indices — measured ~1.3× over the XLA path where
+the table fits VMEM (≈ a few MB, n≈2k at f=128 on v5e); beyond VMEM the
+Mosaic compile fails, so `spmm_pallas` is opt-in, not the default.  It is
+kept as a first-class, tested op (interpret-mode CI + TPU parity): the
+starting point for per-chip blocks small enough to pin in VMEM — which is
+exactly what k-way partitioning produces as k grows (n/k ≈ 2k rows at
+k≈64 for ogbn-arxiv, or any k with bf16 tables at n/k ≲ 16k).
 
 Layout: edges are grouped into tiles of ``TB`` consecutive dst rows (plan
 edge lists are dst-sorted already), each tile padded to ``Emax`` edges;

@@ -19,11 +19,17 @@ therefore just::
     ctx = init_distributed()                  # once per process, before use
     mesh = global_mesh_1d()                   # k = total chips in the job
     trainer = FullBatchTrainer(plan, fin, widths, mesh=mesh)
+    data = make_train_data_multihost(plan, mesh, features, labels)
 
-with data created per-host through the same ``make_train_data`` (jax.Array
-sharding moves each chip's block to its owner automatically on
-``device_put``).  See ``launch/tpu.slurm`` for the batch-script equivalent of
-the reference's ``pytorch.3node.slurm``.
+``make_train_data_multihost`` builds blocks only for this process's chips
+and assembles global arrays via ``jax.make_array_from_process_local_data``
+— the supported multi-process placement path (a plain ``device_put`` of
+host-local arrays to a global sharding is NOT, and the plan-array /
+parameter placement in ``parallel.mesh`` takes the same route when
+``jax.process_count() > 1``).  Exercised end-to-end by the 2-process × 4
+virtual-device integration test (``tests/test_multihost.py``).  See
+``launch/tpu.slurm`` for the batch-script equivalent of the reference's
+``pytorch.3node.slurm``.
 """
 
 from __future__ import annotations

@@ -11,6 +11,7 @@ per-chip arrays are stacked along a leading k axis and sharded with
 from __future__ import annotations
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS = "v"
@@ -23,12 +24,56 @@ def make_mesh_1d(k: int, devices=None) -> Mesh:
     return Mesh(list(devices[:k]), (AXIS,))
 
 
+def local_chip_slice(mesh: Mesh) -> slice:
+    """Positions along the stacked k axis owned by THIS process.
+
+    ``jax.devices()`` orders chips process-contiguously, so a process's
+    chips form one contiguous run of the 1D mesh; verified here because
+    ``make_array_from_process_local_data`` needs the local chunk to be
+    exactly that run.
+    """
+    pid = jax.process_index()
+    mine = [i for i, d in enumerate(mesh.devices.flat)
+            if d.process_index == pid]
+    if not mine:
+        return slice(0, 0)
+    if mine != list(range(mine[0], mine[-1] + 1)):
+        raise ValueError(f"process {pid}'s mesh positions are not "
+                         f"contiguous: {mine}")
+    return slice(mine[0], mine[-1] + 1)
+
+
 def shard_stacked(mesh: Mesh, tree):
-    """Place a pytree of (k, ...)-stacked arrays with the leading axis sharded."""
+    """Place a pytree of (k, ...)-stacked arrays with the leading axis sharded.
+
+    Single-process: plain ``device_put``.  Multi-process (every process
+    holding the full stacked array, e.g. the plan arrays every host builds
+    identically): the SUPPORTED path is
+    ``jax.make_array_from_process_local_data`` fed each process's slice of
+    the leading axis — ``device_put`` of a host-local array to a global
+    sharding is not (the reference's analogous step is each rank reading its
+    own ``H.r``/``A.r`` shard, ``Parallel-GCN/main.c:456-504``).
+    """
     sh = NamedSharding(mesh, P(AXIS))
-    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+    sl = local_chip_slice(mesh)
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(sh, x[sl], x.shape)
+
+    return jax.tree.map(put, tree)
 
 
 def replicate(mesh: Mesh, tree):
+    """Replicate a pytree on every chip (params / optimizer state)."""
     sh = NamedSharding(mesh, P())
-    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(sh, x, x.shape)
+
+    return jax.tree.map(put, tree)

@@ -173,12 +173,25 @@ class CommPlan:
         return (off > 0).sum(axis=1)
 
     # --------------------------------------------------------- data placement
-    def scatter_rows(self, x: np.ndarray, fill: float = 0.0) -> np.ndarray:
-        """Global (n, f) row data → stacked per-chip (k, B, f) padded blocks."""
+    def scatter_rows(self, x: np.ndarray, fill: float = 0.0,
+                     chips=None) -> np.ndarray:
+        """Global (n, f) row data → stacked per-chip (k, B, f) padded blocks.
+
+        ``chips`` restricts the stack to those chip positions (multi-host
+        placement builds only the local run, reading only rows those chips
+        own)."""
         x = np.asarray(x)
         f = x.shape[1] if x.ndim > 1 else 1
-        out = np.full((self.k, self.b, f), fill, dtype=x.dtype)
-        out[self.owner, self.local_idx] = x.reshape(self.n, f)
+        if chips is None:
+            out = np.full((self.k, self.b, f), fill, dtype=x.dtype)
+            out[self.owner, self.local_idx] = x.reshape(self.n, f)
+            return out
+        chips = list(chips)
+        out = np.full((len(chips), self.b, f), fill, dtype=x.dtype)
+        x2 = x.reshape(self.n, f)
+        for i, p in enumerate(chips):
+            sel = self.owner == p
+            out[i, self.local_idx[sel]] = x2[sel]
         return out
 
     def gather_rows(self, blocks: np.ndarray) -> np.ndarray:
@@ -428,10 +441,18 @@ def shared_ell_buckets(plans: list, b: int, combined: bool = False) -> tuple:
         np.maximum(prof[: pl.b], q, out=prof[: pl.b])
     if all(pl.row_order == "degree" for pl in plans):
         return _choose_buckets(prof)
-    # id-ordered rows: one classic tail-bounded width shared by all
+    # id-ordered rows: one classic tail-bounded width shared by all.
+    # Derive each plan's natural combined width from its degree counts
+    # directly — materializing the full cell layout just to read the width
+    # would double the O(nnz) build the caller is about to redo anyway.
     if combined:
-        return ((b, max(max(wb for _, wb in pl.ensure_cell().cell_buckets)
-                        for pl in plans)),)
+        widths = []
+        for pl in plans:
+            alldeg = np.concatenate(
+                [np.bincount(pl.edge_dst[p, : int(pl.nnz[p])], minlength=pl.b)
+                 for p in range(pl.k)])
+            widths.append(_single_bucket_width(alldeg, tail_frac=0.02))
+        return ((b, max(widths)),)
     return ((b, max(pl.ell_k for pl in plans)),)
 
 

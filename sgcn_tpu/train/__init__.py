@@ -1,3 +1,5 @@
-from .fullbatch import FullBatchTrainer, TrainData, make_train_data
+from .fullbatch import (FullBatchTrainer, TrainData, make_train_data,
+                        make_train_data_multihost)
 
-__all__ = ["FullBatchTrainer", "TrainData", "make_train_data"]
+__all__ = ["FullBatchTrainer", "TrainData", "make_train_data",
+           "make_train_data_multihost"]

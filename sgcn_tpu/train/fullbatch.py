@@ -104,6 +104,59 @@ def make_train_data(
     return TrainData(h0=h0, labels=lab, train_valid=tv, eval_valid=ev)
 
 
+def make_train_data_multihost(
+    plan: CommPlan,
+    mesh,
+    features: np.ndarray,
+    labels: np.ndarray,
+    train_mask: np.ndarray | None = None,
+    eval_mask: np.ndarray | None = None,
+) -> TrainData:
+    """Multi-process data placement: each process materializes blocks ONLY
+    for its own chips and assembles the global sharded arrays with
+    ``jax.make_array_from_process_local_data`` — the supported multi-host
+    path (a ``device_put`` of host-local data to a global sharding is not).
+
+    ``features``/``labels``/masks are indexed globally, but only rows owned
+    by this process's chips are READ — each host may leave remote rows as
+    zeros / memory-mapped, exactly like each MPI rank reading only its own
+    ``H.r`` shard (``Parallel-GCN/main.c:456-504``; SLURM deployment
+    ``GPU/pytorch.3node.slurm:46-56`` + ``GPU/PGCN.py:241-260``).
+
+    Returns a ``TrainData`` of global jax.Arrays, drop-in for ``step`` /
+    ``run_epochs`` / ``evaluate``.
+    """
+    import jax
+
+    from ..parallel.mesh import local_chip_slice
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = plan.n
+    sl = local_chip_slice(mesh)
+    chips = range(plan.k)[sl]
+    if train_mask is None:
+        train_mask = np.ones(n, dtype=np.float32)
+    if eval_mask is None:
+        eval_mask = train_mask
+
+    sh = NamedSharding(mesh, P(AXIS))
+
+    def put(local, gshape):
+        if jax.process_count() == 1:
+            return jax.device_put(local, sh)
+        return jax.make_array_from_process_local_data(sh, local, gshape)
+
+    scatter = lambda x, dt: plan.scatter_rows(  # noqa: E731 — local shorthand
+        np.asarray(x, dtype=dt).reshape(n, -1), chips=chips)
+    f = features.shape[1]
+    rv = plan.row_valid[sl]
+    h0 = put(scatter(features, np.float32), (plan.k, plan.b, f))
+    lab = put(scatter(labels, np.int32)[..., 0], (plan.k, plan.b))
+    tv = put(scatter(train_mask, np.float32)[..., 0] * rv, (plan.k, plan.b))
+    ev = put(scatter(eval_mask, np.float32)[..., 0] * rv, (plan.k, plan.b))
+    return TrainData(h0=h0, labels=lab, train_valid=tv, eval_valid=ev)
+
+
 def _plan_arrays(plan: CommPlan, fields) -> dict:
     return {f: getattr(plan, f) for f in fields}
 

@@ -103,17 +103,23 @@ class MiniBatchTrainer:
         env = tuple(max(getattr(p, f) for p in raw)
                     for f in ("b", "s", "r", "e", "el", "eh", "tl"))
         shared = shared_ell_buckets(raw, env[0])
-        # the combined-edge (GAT) layout is lazy; force a SHARED structure
-        # across batch plans only when the model will ship it
-        cshared = (shared_ell_buckets(raw, env[0], combined=True)
-                   if model == "gat" else None)
-        self.plans = [pad_comm_plan(p, *env, ell_buckets=shared,
-                                    cell_buckets=cshared) for p in raw]
+        self.plans = [pad_comm_plan(p, *env, ell_buckets=shared) for p in raw]
         if model == "gat":
-            # the shared envelope must also share the combined-tail length
-            ctl_max = max(p.ctl for p in self.plans)
+            # the combined-edge (GAT) layout is lazy; build it ONCE per plan
+            # with a shared bucket structure AND a shared tail length (the
+            # spill is derivable from degree profiles without materializing)
+            cshared = shared_ell_buckets(self.plans, env[0], combined=True)
+            caps = np.concatenate(
+                [np.full(nb, wb, np.int64) for nb, wb in cshared])
+            ctl_shared = 1
             for p in self.plans:
-                p.ensure_cell(buckets=cshared, ctl=ctl_max)
+                for chip in range(k):
+                    deg = np.bincount(p.edge_dst[chip][: int(p.nnz[chip])],
+                                      minlength=p.b)
+                    ctl_shared = max(ctl_shared, int(
+                        np.maximum(deg - caps[: p.b], 0).sum()))
+            for p in self.plans:
+                p.ensure_cell(buckets=cshared, ctl=ctl_shared)
         # one compiled step serves every batch, so the symmetric fast path is
         # only safe if every batch plan is symmetric (sampled subgraphs of a
         # symmetric graph are, but keep the guard exact)

@@ -87,3 +87,60 @@ def test_edge_softmax_matches_dense():
                                              1e-9))
     np.testing.assert_allclose(alpha, ref[dst, np.arange(n * deg)],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_gat_sym_backward_matches_autodiff(ahat):
+    """The gather-only symmetric backward must produce the same gradients as
+    JAX's mechanical transpose of the streaming forward."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from sgcn_tpu.models.gat import (GAT_PLAN_FIELDS, gat_layer_local,
+                                     gat_layer_sym)
+    from sgcn_tpu.parallel import make_mesh_1d, shard_stacked
+    from sgcn_tpu.partition import balanced_random_partition
+
+    n, k, fin, fout = ahat.shape[0], 4, 6, 5
+    plan = build_comm_plan(ahat, balanced_random_partition(n, k, seed=3), k)
+    plan.ensure_cell()
+    assert plan.symmetric
+    mesh = make_mesh_1d(k)
+    rng = np.random.default_rng(2)
+    h = rng.standard_normal((n, fin)).astype(np.float32)
+    params = init_gat_params(jax.random.PRNGKey(1), [(fin, fout)])[0]
+    hb = shard_stacked(mesh, plan.scatter_rows(h))
+    pa = shard_stacked(mesh, {f: getattr(plan, f) for f in GAT_PLAN_FIELDS})
+
+    def make(layer):
+        def per_chip(pa, h):
+            pa = jax.tree.map(lambda x: x[0], pa)
+
+            def obj(w, a1, a2, hl):
+                out = layer(w, a1, a2, hl, pa["send_idx"], pa["halo_src"],
+                            pa["cell_idx"], pa["cell_w"], pa["ctail_dst"],
+                            pa["ctail_src"], pa["ctail_w"],
+                            pa["row_valid"], plan.cell_buckets, "v")
+                return jax.lax.psum(jnp.sum(out * jnp.cos(out * 0.3)), "v")
+
+            g = jax.grad(obj, argnums=(0, 1, 2, 3))(
+                params["w"], params["a1"], params["a2"], h[0])
+            return jax.tree.map(lambda x: x[None], g)
+
+        fn = jax.jit(jax.shard_map(per_chip, mesh=mesh,
+                                   in_specs=(P("v"), P("v")),
+                                   out_specs=P("v")))
+        return fn(pa, hb)
+
+    g_auto = make(gat_layer_local)
+    g_sym = make(gat_layer_sym)
+    # Param grads follow the trainer convention: per-chip PARTIALS that the
+    # trainer completes with an explicit psum (fullbatch.py).  Autodiff of
+    # closure-captured (replicated) params gets shard_map's automatic
+    # replication-psum instead, so compare the chip-summed totals.
+    for ga, gs, name in zip(g_auto[:3], g_sym[:3], ("w", "a1", "a2")):
+        np.testing.assert_allclose(np.asarray(gs).sum(axis=0),
+                                   np.asarray(ga)[0],
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+    # dh is vertex-sharded (no replication), so it must match per chip
+    np.testing.assert_allclose(np.asarray(g_sym[3]), np.asarray(g_auto[3]),
+                               rtol=2e-4, atol=2e-5, err_msg="h")

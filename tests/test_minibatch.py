@@ -121,3 +121,21 @@ def test_minibatch_stats_vocabulary(ahat):
     assert report["total_send_volume"] == want
     assert report["total_send_volume"] == report["total_recv_volume"]
     assert report["total_send_volume"] == report["total_exchanged_rows"]
+
+
+def test_minibatch_gat_trains(ahat):
+    """GAT mini-batch: shared combined-edge envelope (buckets + tail) across
+    batch plans, one compiled step, finite decreasing loss."""
+    n = ahat.shape[0]
+    rng = np.random.default_rng(9)
+    pv = balanced_random_partition(n, K, seed=4)
+    feats = rng.standard_normal((n, 6)).astype(np.float32)
+    labels = rng.integers(0, 3, n).astype(np.int32)
+    tr = MiniBatchTrainer(ahat, pv, K, fin=6, widths=[5, 3],
+                          batch_size=16, model="gat", activation="none",
+                          seed=0)
+    # every batch plan shares ONE combined-edge envelope
+    envs = {(p.cell_buckets, p.ctl) for p in tr.plans}
+    assert len(envs) == 1
+    report = tr.fit(feats, labels, epochs=3, verbose=False)
+    assert np.isfinite(report["loss_history"]).all()
