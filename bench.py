@@ -33,6 +33,34 @@ def synth_graph(n: int, avg_deg: int, seed: int = 0) -> sp.csr_matrix:
     return er_graph(n, avg_deg, seed)
 
 
+def diff_time(make_run, lo: int, hi: int, reps: int = 5,
+              retries: int = 3) -> float:
+    """The round-3 differential protocol, shared by every bench mode:
+    ``make_run(nep)`` returns a zero-arg callable that runs ``nep``
+    on-device epochs and returns a synced finite scalar; the per-call
+    tunnel constant (~110 ms) cancels in ``(t_hi − t_lo)/(hi − lo)``."""
+    def once(nep):
+        run = make_run(nep)
+        run()                                     # compile + warm, retired
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            v = run()
+            ts.append(time.perf_counter() - t0)
+            if not np.isfinite(v):
+                raise RuntimeError(f"non-finite loss {v}")
+        return statistics.median(ts)
+
+    for _ in range(retries):
+        t_lo, t_hi = once(lo), once(hi)
+        if t_hi > t_lo:
+            return (t_hi - t_lo) / (hi - lo)
+    # never fabricate a near-zero number out of tunnel noise
+    raise RuntimeError(
+        f"differential timing failed: t({hi} ep)={t_hi:.4f}s <= "
+        f"t({lo} ep)={t_lo:.4f}s after {retries} attempts (chip contention?)")
+
+
 def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn"):
     import jax
 
@@ -68,38 +96,56 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn"):
                                mesh=mesh, **kw)
     data = make_train_data(plan, feats, labels)
     data = type(data)(**shard_stacked(mesh, vars(data)))
-    # DIFFERENTIAL timing (round-3 protocol): this box reaches its chip
-    # through a tunnel whose fixed cost per jitted call is ~110 ms; dividing
-    # a round's wall-clock by its epoch count silently adds 110ms/epochs to
-    # the result (every round-1/2 number did).  Instead run `lo` and `hi`
-    # epochs as single on-device fori_loop programs (run_epochs) and report
-    # (t_hi - t_lo)/(hi - lo): the per-call constant cancels exactly,
-    # leaving pure device time per epoch — what a host-attached TPU would
-    # see, and the reference's "timed epochs after warm-up" quantity
-    # (GPU/PGCN.py:202-228).
-    lo, hi = 1, max(3, epochs)
-
-    def measure(nep):
-        losses = trainer.run_epochs(data, nep, sync=False)   # compile + warm
-        float(losses[-1])                     # retire the warm-up program
-        ts = []
-        for _ in range(5):
-            t0 = time.perf_counter()
+    # DIFFERENTIAL timing (round-3 protocol, see diff_time): the reference's
+    # "timed epochs after warm-up" quantity (GPU/PGCN.py:202-228) free of
+    # the tunnel's per-dispatch constant.
+    def make_run(nep):
+        def run():
             losses = trainer.run_epochs(data, nep, sync=False)
-            last = float(losses[-1])              # scalar readback = sync
-            ts.append(time.perf_counter() - t0)
-            if not np.isfinite(last):
-                raise RuntimeError(f"non-finite loss {last}")
-        return statistics.median(ts)
+            return float(losses[-1])              # scalar readback = sync
+        return run
 
-    for attempt in range(3):
-        t_lo, t_hi = measure(lo), measure(hi)
-        if t_hi > t_lo:
-            return (t_hi - t_lo) / (hi - lo), part_metrics
-    # never fabricate a near-zero flagship number out of tunnel noise
-    raise RuntimeError(
-        f"differential timing failed: t({hi} ep)={t_hi:.4f}s <= "
-        f"t({lo} ep)={t_lo:.4f}s after 3 attempts (chip contention?)")
+    return diff_time(make_run, 1, max(3, epochs)), part_metrics
+
+
+def bench_minibatch(ahat, feats, labels, widths, batch_size: int,
+                    epochs: int):
+    """Mini-batch trainer epoch (PGCN-Mini-batch role, Reddit-config shape):
+    one pass over all pre-sampled batches, run as ONE on-device program
+    (``run_epochs_fused``) and timed differentially like the flagship."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from sgcn_tpu.train.minibatch import MiniBatchTrainer
+
+    k = len(jax.devices())
+    n = ahat.shape[0]
+    if k > 1:
+        from sgcn_tpu.partition import partition_hypergraph_colnet
+        pv, _ = partition_hypergraph_colnet(ahat, k, seed=0)
+    else:
+        pv = np.zeros(n, dtype=np.int64)
+    tr = MiniBatchTrainer(ahat, pv, k, fin=feats.shape[1], widths=widths,
+                          batch_size=batch_size)
+
+    def make_run(nep):
+        def run():
+            losses = tr.run_epochs_fused(feats, labels, epochs=nep,
+                                         sync=False)
+            return float(losses[-1])
+        return run
+
+    epoch_s = diff_time(make_run, 1, max(3, epochs))
+    return epoch_s, {
+        "nbatches": len(tr.plans),
+        "batch_size": batch_size,
+        # deterministic per-epoch figure (the trainer-level CommStats
+        # counters accumulate over warm-ups/retries and are not a metric)
+        "comm_volume_rows_per_epoch":
+            sum(int(p.predicted_send_volume.sum()) for p in tr.plans)
+            * 2 * len(widths),
+    }
 
 
 def bench_dense_equiv(n: int, fin: int, widths, epochs: int) -> float:
@@ -148,26 +194,18 @@ def bench_dense_equiv(n: int, fin: int, widths, epochs: int) -> float:
         return run
 
     # same differential protocol as bench_jax (tunnel per-call constant)
-    lo, hi = 1, max(3, epochs)
     compiled = {}                 # nep -> jitted program (reused across retries)
 
-    def measure(nep):
+    def make_run(nep):
         if nep not in compiled:
             compiled[nep] = multi(nep)
         run = compiled[nep]
-        float(run(params, opt_state)[2])          # compile + warm
-        ts = []
-        for _ in range(5):
-            t0 = time.perf_counter()
-            float(run(params, opt_state)[2])      # scalar readback = sync
-            ts.append(time.perf_counter() - t0)
-        return statistics.median(ts)
+        return lambda: float(run(params, opt_state)[2])
 
-    for attempt in range(3):
-        t_lo, t_hi = measure(lo), measure(hi)
-        if t_hi > t_lo:
-            return (t_hi - t_lo) / (hi - lo)
-    return float("nan")       # diagnostic yardstick only; caller emits null
+    try:
+        return diff_time(make_run, 1, max(3, epochs))
+    except RuntimeError:
+        return float("nan")   # diagnostic yardstick only; caller emits null
 
 
 def bench_torch_reference(ahat, feats, labels, widths, epochs: int) -> float:
@@ -255,6 +293,9 @@ def main() -> None:
                         "torch/dense yardsticks are GCN-shaped, so they are "
                         "skipped for gat")
     p.add_argument("-e", "--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="bench the mini-batch trainer (fused epoch sweep) "
+                        "instead of the full-batch flagship")
     p.add_argument("--skip-torch", action="store_true")
     p.add_argument("--skip-vdev", action="store_true",
                    help="skip the virtual-8-device partitioned diagnostic run")
@@ -270,6 +311,21 @@ def main() -> None:
     feats = rng.standard_normal((args.n, args.f)).astype(np.float32)
     labels = rng.integers(0, args.classes, size=args.n).astype(np.int32)
     widths = [args.hidden] * (args.layers - 1) + [args.classes]
+
+    if args.batch_size is not None:
+        if args.model != "gcn":
+            raise SystemExit(
+                "--batch-size benches the GCN mini-batch trainer; "
+                "--model gat is not wired through it")
+        mb_s, mb_metrics = bench_minibatch(ahat, feats, labels, widths,
+                                           args.batch_size, args.epochs)
+        print(json.dumps({
+            "metric": "minibatch_gcn_epoch_time",
+            "value": round(mb_s, 6),
+            "unit": "s",
+            **mb_metrics,
+        }))
+        return
 
     epoch_s, part_metrics = bench_jax(ahat, feats, labels, widths, args.epochs,
                                       model=args.model)

@@ -139,3 +139,31 @@ def test_minibatch_gat_trains(ahat):
     assert len(envs) == 1
     report = tr.fit(feats, labels, epochs=3, verbose=False)
     assert np.isfinite(report["loss_history"]).all()
+
+
+def test_fused_epoch_matches_stepwise(ahat):
+    """The one-program epoch sweep (fori over batches on-device) must follow
+    the exact trajectory of sequential per-batch step() calls."""
+    n = ahat.shape[0]
+    rng = np.random.default_rng(5)
+    pv = balanced_random_partition(n, K, seed=2)
+    feats = rng.standard_normal((n, 7)).astype(np.float32)
+    labels = rng.integers(0, 3, size=n).astype(np.int32)
+    kw = dict(batch_size=16, nbatches=4, lr=0.05, seed=3)
+    seq = MiniBatchTrainer(ahat, pv, K, fin=7, widths=[6, 3], **kw)
+    fused = MiniBatchTrainer(ahat, pv, K, fin=7, widths=[6, 3], **kw)
+    batches = seq.make_batches(feats, labels)
+    seq_losses = []
+    for _ in range(2):
+        seq_losses.append(np.mean([seq.step(b) for b in batches]))
+    fused_losses = fused.run_epochs_fused(feats, labels, epochs=2)
+    np.testing.assert_allclose(fused_losses, seq_losses, rtol=2e-5, atol=1e-6)
+    # params identical afterward
+    for a, b in zip(seq.inner.params, fused.inner.params):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+    # comm accounting carries the full 8-number vocabulary
+    rep = fused.fused_stats_report()
+    expected = sum(int(p.predicted_send_volume.sum())
+                   for p in fused.plans) * 2 * 2 * 2  # ep × layers × fwd+bwd
+    assert rep["total_send_volume"] == expected
