@@ -61,7 +61,8 @@ def diff_time(make_run, lo: int, hi: int, reps: int = 5,
         f"t({lo} ep)={t_lo:.4f}s after {retries} attempts (chip contention?)")
 
 
-def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn"):
+def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
+              dtype: str | None = None, remat: bool = False):
     import jax
 
     # The axon sitecustomize pre-registers the TPU plugin at interpreter
@@ -76,6 +77,8 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn"):
     k = len(jax.devices())
     n = ahat.shape[0]
     part_metrics = {"partitioner": "none", "km1": 0}
+    if dtype is not None:
+        part_metrics["compute_dtype"] = dtype
     if k > 1:
         # the flagship bench exercises the paper's core idea: comm volume is
         # driven by the native hypergraph partitioner, never random
@@ -93,7 +96,8 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn"):
     # (GPU/PGAT.py:202-213; same default as the trainer CLI)
     kw = {"model": "gat", "activation": "none"} if model == "gat" else {}
     trainer = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths,
-                               mesh=mesh, **kw)
+                               mesh=mesh, compute_dtype=dtype, remat=remat,
+                               **kw)
     data = make_train_data(plan, feats, labels)
     data = type(data)(**shard_stacked(mesh, vars(data)))
     # DIFFERENTIAL timing (round-3 protocol, see diff_time): the reference's
@@ -109,7 +113,7 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn"):
 
 
 def bench_minibatch(ahat, feats, labels, widths, batch_size: int,
-                    epochs: int):
+                    epochs: int, dtype: str | None = None):
     """Mini-batch trainer epoch (PGCN-Mini-batch role, Reddit-config shape):
     one pass over all pre-sampled batches, run as ONE on-device program
     (``run_epochs_fused``) and timed differentially like the flagship."""
@@ -127,7 +131,7 @@ def bench_minibatch(ahat, feats, labels, widths, batch_size: int,
     else:
         pv = np.zeros(n, dtype=np.int64)
     tr = MiniBatchTrainer(ahat, pv, k, fin=feats.shape[1], widths=widths,
-                          batch_size=batch_size)
+                          batch_size=batch_size, compute_dtype=dtype)
 
     def make_run(nep):
         def run():
@@ -296,6 +300,11 @@ def main() -> None:
     p.add_argument("--batch-size", type=int, default=None,
                    help="bench the mini-batch trainer (fused epoch sweep) "
                         "instead of the full-batch flagship")
+    p.add_argument("--dtype", default=None, choices=["bfloat16"],
+                   help="mixed-precision compute (f32 master params)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize layer activations in the backward "
+                        "(HBM-for-FLOPs trade for huge vertex counts)")
     p.add_argument("--skip-torch", action="store_true")
     p.add_argument("--skip-vdev", action="store_true",
                    help="skip the virtual-8-device partitioned diagnostic run")
@@ -317,8 +326,14 @@ def main() -> None:
             raise SystemExit(
                 "--batch-size benches the GCN mini-batch trainer; "
                 "--model gat is not wired through it")
+        if args.remat:
+            raise SystemExit("--remat is not wired through the mini-batch "
+                             "trainer; drop it or bench full-batch")
         mb_s, mb_metrics = bench_minibatch(ahat, feats, labels, widths,
-                                           args.batch_size, args.epochs)
+                                           args.batch_size, args.epochs,
+                                           dtype=args.dtype)
+        if args.dtype:
+            mb_metrics["compute_dtype"] = args.dtype
         print(json.dumps({
             "metric": "minibatch_gcn_epoch_time",
             "value": round(mb_s, 6),
@@ -328,7 +343,8 @@ def main() -> None:
         return
 
     epoch_s, part_metrics = bench_jax(ahat, feats, labels, widths, args.epochs,
-                                      model=args.model)
+                                      model=args.model, dtype=args.dtype,
+                                      remat=args.remat)
     if args.model == "gat":
         args.skip_torch = True          # yardsticks below are GCN-shaped
         args.skip_vdev = True

@@ -35,6 +35,12 @@ from jax import lax
 
 from ..parallel.mesh import AXIS
 
+# bound on the gather temps XLA's latency-hiding scheduler can keep live
+# concurrently on the unrolled path (it overlaps up to ~16 slots); above it
+# spmm_ell switches to a lax.scan over width slots (exactly one temp live)
+_CONCURRENT_TEMP_LIMIT = 3 * 1024**3 // 2
+_SCHED_OVERLAP_SLOTS = 16
+
 
 def halo_exchange(h, send_idx, halo_src, axis_name: str = AXIS):
     """Exchange boundary rows; return this chip's halo row block.
@@ -133,15 +139,43 @@ def spmm_ell(ell_idx, ell_w, tail_dst, tail_src, tail_w, h, buckets):
         raise ValueError(
             f"bucket structure {buckets} does not cover the flat ELL arrays "
             f"({ell_idx.shape[0]} slots) — pass the owning plan's ell_buckets")
+    f = h.shape[-1]
     outs = []
     off = 0
     for nb, wb in buckets:
-        acc = None
-        for t in range(wb):
-            seg = slice(off + t * nb, off + (t + 1) * nb)
-            g = jnp.take(h, ell_idx[seg], axis=0) * ell_w[seg][:, None]
-            acc = g if acc is None else acc + g
-        outs.append(acc)
+        live = min(wb, _SCHED_OVERLAP_SLOTS) * nb * f * 4
+        if live <= _CONCURRENT_TEMP_LIMIT or wb <= 2:
+            # unrolled fast path: every slot's gather·w fuses into its add
+            acc = None
+            for t in range(wb):
+                seg = slice(off + t * nb, off + (t + 1) * nb)
+                g = jnp.take(h, ell_idx[seg], axis=0) * ell_w[seg][:, None]
+                acc = g if acc is None else acc + g
+            outs.append(acc)
+        else:
+            # huge buckets (ogbn-products-scale rows): unrolling lets XLA's
+            # latency-hiding scheduler keep tens of (nb, f) gather temps
+            # live at once — measured 17+ GB of HLO temps at n=2.45M on a
+            # 16 GB chip.  scan serializes the slots so exactly ONE gather
+            # temp exists at a time; per-gather latency amortizes over the
+            # huge row count, so the lost overlap is noise.  The
+            # width-major flat layout makes each slot a contiguous (nb,)
+            # run, so the (wb, nb) reshape below is free.
+            seg_i = ell_idx[off: off + nb * wb].reshape(wb, nb)
+            seg_w = ell_w[off: off + nb * wb].reshape(wb, nb)
+
+            def body(acc, iw):
+                idx_t, w_t = iw
+                return acc + jnp.take(h, idx_t, axis=0) * w_t[:, None], None
+
+            # carry must match the body output's varying-axes type under
+            # shard_map; adding 0·(an int32 element of the sharded index
+            # array) marks the zeros varying — integer 0·x is exactly 0,
+            # so (unlike 0·h[0,0]) an inf/NaN activation cannot poison it
+            init = (jnp.zeros((nb, f), h.dtype)
+                    + (seg_i[0, 0] * 0).astype(h.dtype))
+            acc, _ = jax.lax.scan(body, init, (seg_i, seg_w))
+            outs.append(acc)
         off += nb * wb
     out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
     tg = jnp.take(h, tail_src, axis=0) * tail_w[:, None]

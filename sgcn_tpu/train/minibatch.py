@@ -32,7 +32,7 @@ from ..parallel.mesh import make_mesh_1d, shard_stacked
 from ..parallel.plan import build_comm_plan, pad_comm_plan, shared_ell_buckets
 from ..utils.stats import CommStats
 from .fullbatch import (FullBatchTrainer, TrainData, _plan_arrays,
-                        make_train_data)
+                        _unblock, make_train_data)
 
 
 def sample_batches(n: int, batch_size: int, nbatches: int | None = None,
@@ -206,7 +206,9 @@ class MiniBatchTrainer:
         """Stack every batch's plan arrays and data along a new axis 1:
         (k, nb, ...) — shard axis stays leading, so one shard_map program
         can ``fori_loop`` over batches on-device."""
-        pa = {f: np.stack([getattr(p, f) for p in self.plans], axis=1)
+        per_plan = [_plan_arrays(p, self.inner.plan_fields)
+                    for p in self.plans]
+        pa = {f: np.stack([d[f] for d in per_plan], axis=1)
               for f in self.inner.plan_fields}
         datas = []
         for bv, p in zip(self.batches_idx, self.plans):
@@ -237,8 +239,7 @@ class MiniBatchTrainer:
         nb = len(self.plans)
 
         def per_chip(params, opt_state, pa_s, h0, lab, val):
-            pa_s, h0, lab, val = jax.tree.map(
-                lambda x: x[0], (pa_s, h0, lab, val))
+            pa_s, h0, lab, val = _unblock((pa_s, h0, lab, val))
 
             def batch_body(i, carry):
                 params, opt_state, losses, _ = carry
@@ -278,7 +279,9 @@ class MiniBatchTrainer:
         key = (np.asarray(features).shape, np.asarray(labels).shape,
                None if train_mask is None else np.asarray(train_mask).shape,
                float(np.asarray(features).ravel()[:16].sum()),
-               int(np.asarray(labels).ravel()[:16].sum()))
+               int(np.asarray(labels).ravel()[:16].sum()),
+               None if train_mask is None
+               else float(np.asarray(train_mask).sum()))
         if self._fused_inputs is None or key != self._fused_key:
             self._fused_inputs = self._stack_inputs(features, labels,
                                                     train_mask)
