@@ -214,26 +214,27 @@ def _mask_slot_pass(table_f, table_b, cell_idx, cell_w, ctail_dst, ctail_src,
 
     Returns ``(N, D)``: (b, f) feature sums and (b,) scalar sums.
     """
+    from ..ops.pspmm import bucketed_slot_reduce
+
     fout = table_f.shape[-1]
     lanes = table_b.shape[-1]
-    ns, ds = [], []
-    off = 0
-    for nb, wb in buckets:
-        n_acc = jnp.zeros((nb, fout), jnp.float32)
-        d_acc = jnp.zeros((nb,), jnp.float32)
-        for t in range(wb):
-            seg = slice(off + t * nb, off + (t + 1) * nb)
-            idx = cell_idx[seg]
-            mask = (cell_w[seg] > 0).astype(jnp.float32)
-            n_acc = n_acc + jnp.take(table_f, idx, axis=0) * mask[:, None]
-            # row-sum consumes every lane of the broadcast tile: the gather
-            # stays a fast full-tile fetch (slicing one lane would let XLA
-            # narrow it onto the 3.2×-slower sub-tile path)
-            d_acc = d_acc + jnp.take(table_b, idx, axis=0).sum(axis=-1) \
-                * (mask / lanes)
-        ns.append(n_acc)
-        ds.append(d_acc)
-        off += nb * wb
+
+    def contrib(idx, wv):
+        mask = (wv > 0).astype(jnp.float32)
+        n = jnp.take(table_f, idx, axis=0) * mask[:, None]
+        # row-sum consumes every lane of the broadcast tile: the gather
+        # stays a fast full-tile fetch (slicing one lane would let XLA
+        # narrow it onto the 3.2×-slower sub-tile path)
+        d = jnp.take(table_b, idx, axis=0).sum(axis=-1) * (mask / lanes)
+        return n, d
+
+    outs = bucketed_slot_reduce(
+        cell_idx, cell_w, buckets, contrib=contrib,
+        init=lambda nb: (jnp.zeros((nb, fout), jnp.float32),
+                         jnp.zeros((nb,), jnp.float32)),
+        slot_bytes=lambda nb: nb * (fout + lanes) * 4)
+    ns = [o[0] for o in outs]
+    ds = [o[1] for o in outs]
     n_out = ns[0] if len(ns) == 1 else jnp.concatenate(ns, axis=0)
     d_out = ds[0] if len(ds) == 1 else jnp.concatenate(ds)
     tmask = (ctail_w > 0).astype(jnp.float32)

@@ -37,9 +37,60 @@ from ..parallel.mesh import AXIS
 
 # bound on the gather temps XLA's latency-hiding scheduler can keep live
 # concurrently on the unrolled path (it overlaps up to ~16 slots); above it
-# spmm_ell switches to a lax.scan over width slots (exactly one temp live)
+# the bucketed slot reduce switches to a lax.scan over width slots (exactly
+# one temp live)
 _CONCURRENT_TEMP_LIMIT = 3 * 1024**3 // 2
 _SCHED_OVERLAP_SLOTS = 16
+
+
+def bucketed_slot_reduce(flat_idx, flat_w, buckets, contrib, init,
+                         slot_bytes):
+    """Σ over width slots of ``contrib(idx_t, w_t)`` per bucket — THE shared
+    memory policy for every bucketed width-major layout (GCN SpMM, GAT
+    attention passes).
+
+    Unrolled while the scheduler's concurrent gather temps
+    (``min(wb, _SCHED_OVERLAP_SLOTS) · slot_bytes(nb)``) fit the budget —
+    each slot's gather fuses into its add; above it (ogbn-products-scale
+    buckets: tens of multi-hundred-MB temps measured as 17+ GB of HLO temps
+    on a 16 GB chip) a ``lax.scan`` serializes the slots so exactly one
+    temp is live, with per-gather latency amortized over the huge row
+    count.  The width-major flat layout makes each slot a contiguous
+    ``(nb,)`` run, so the ``(wb, nb)`` reshape is free.
+
+    ``contrib(idx (nb,), w (nb,)) -> pytree of (nb, ...) f32 arrays``;
+    ``init(nb)`` builds the matching zero pytree; ``slot_bytes(nb)``
+    estimates one slot's gather-temp bytes.  Returns the per-bucket reduced
+    pytrees in bucket order.
+    """
+    outs = []
+    off = 0
+    for nb, wb in buckets:
+        if (min(wb, _SCHED_OVERLAP_SLOTS) * slot_bytes(nb)
+                <= _CONCURRENT_TEMP_LIMIT) or wb <= 2:
+            acc = None
+            for t in range(wb):
+                seg = slice(off + t * nb, off + (t + 1) * nb)
+                c = contrib(flat_idx[seg], flat_w[seg])
+                acc = c if acc is None else jax.tree.map(jnp.add, acc, c)
+        else:
+            seg_i = flat_idx[off: off + nb * wb].reshape(wb, nb)
+            seg_w = flat_w[off: off + nb * wb].reshape(wb, nb)
+            # carry must match the body output's varying-axes type under
+            # shard_map; adding 0·(an int32 element of the sharded index
+            # array) marks the zeros varying — integer 0·x is exactly 0,
+            # so (unlike 0·h[0,0]) an inf/NaN activation cannot poison it
+            zero = seg_i[0, 0] * 0
+
+            def body(carry, iw):
+                i_t, w_t = iw
+                return jax.tree.map(jnp.add, carry, contrib(i_t, w_t)), None
+
+            acc0 = jax.tree.map(lambda x: x + zero.astype(x.dtype), init(nb))
+            acc, _ = jax.lax.scan(body, acc0, (seg_i, seg_w))
+        outs.append(acc)
+        off += nb * wb
+    return outs
 
 
 def halo_exchange(h, send_idx, halo_src, axis_name: str = AXIS):
@@ -140,43 +191,11 @@ def spmm_ell(ell_idx, ell_w, tail_dst, tail_src, tail_w, h, buckets):
             f"bucket structure {buckets} does not cover the flat ELL arrays "
             f"({ell_idx.shape[0]} slots) — pass the owning plan's ell_buckets")
     f = h.shape[-1]
-    outs = []
-    off = 0
-    for nb, wb in buckets:
-        live = min(wb, _SCHED_OVERLAP_SLOTS) * nb * f * 4
-        if live <= _CONCURRENT_TEMP_LIMIT or wb <= 2:
-            # unrolled fast path: every slot's gather·w fuses into its add
-            acc = None
-            for t in range(wb):
-                seg = slice(off + t * nb, off + (t + 1) * nb)
-                g = jnp.take(h, ell_idx[seg], axis=0) * ell_w[seg][:, None]
-                acc = g if acc is None else acc + g
-            outs.append(acc)
-        else:
-            # huge buckets (ogbn-products-scale rows): unrolling lets XLA's
-            # latency-hiding scheduler keep tens of (nb, f) gather temps
-            # live at once — measured 17+ GB of HLO temps at n=2.45M on a
-            # 16 GB chip.  scan serializes the slots so exactly ONE gather
-            # temp exists at a time; per-gather latency amortizes over the
-            # huge row count, so the lost overlap is noise.  The
-            # width-major flat layout makes each slot a contiguous (nb,)
-            # run, so the (wb, nb) reshape below is free.
-            seg_i = ell_idx[off: off + nb * wb].reshape(wb, nb)
-            seg_w = ell_w[off: off + nb * wb].reshape(wb, nb)
-
-            def body(acc, iw):
-                idx_t, w_t = iw
-                return acc + jnp.take(h, idx_t, axis=0) * w_t[:, None], None
-
-            # carry must match the body output's varying-axes type under
-            # shard_map; adding 0·(an int32 element of the sharded index
-            # array) marks the zeros varying — integer 0·x is exactly 0,
-            # so (unlike 0·h[0,0]) an inf/NaN activation cannot poison it
-            init = (jnp.zeros((nb, f), h.dtype)
-                    + (seg_i[0, 0] * 0).astype(h.dtype))
-            acc, _ = jax.lax.scan(body, init, (seg_i, seg_w))
-            outs.append(acc)
-        off += nb * wb
+    outs = bucketed_slot_reduce(
+        ell_idx, ell_w, buckets,
+        contrib=lambda idx, w: jnp.take(h, idx, axis=0) * w[:, None],
+        init=lambda nb: jnp.zeros((nb, f), h.dtype),
+        slot_bytes=lambda nb: nb * f * 4)
     out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
     tg = jnp.take(h, tail_src, axis=0) * tail_w[:, None]
     return out.at[tail_dst].add(tg)
