@@ -206,47 +206,99 @@ def gat_layer_sym(w, a1, a2, h, send_idx, halo_src, cell_idx, cell_w,
     return out
 
 
-def _mask_slot_pass(table_f, table_b, cell_idx, cell_w, ctail_dst, ctail_src,
-                    ctail_w, buckets, b):
-    """Shared aggregation core: Σ over in-edge slots of ``mask·table_f[src]``
-    (feature rows) and ``mask·table_b[src]`` (lane-broadcast scalar rows,
-    consumed by row-sum), plus the hub tail via segment ops.
-
-    Returns ``(N, D)``: (b, f) feature sums and (b,) scalar sums.
-    """
+def _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets,
+               b, fout, contrib, slot_bytes):
+    """Shared scaffold for every masked in-edge aggregation: bucketed slot
+    reduce + hub-tail fold, parameterized by the per-slot ``contrib``
+    (which also decodes the tail — the tail IS one more masked slot)."""
     from ..ops.pspmm import bucketed_slot_reduce
-
-    fout = table_f.shape[-1]
-    lanes = table_b.shape[-1]
-
-    def contrib(idx, wv):
-        mask = (wv > 0).astype(jnp.float32)
-        n = jnp.take(table_f, idx, axis=0) * mask[:, None]
-        # row-sum consumes every lane of the broadcast tile: the gather
-        # stays a fast full-tile fetch (slicing one lane would let XLA
-        # narrow it onto the 3.2×-slower sub-tile path)
-        d = jnp.take(table_b, idx, axis=0).sum(axis=-1) * (mask / lanes)
-        return n, d
 
     outs = bucketed_slot_reduce(
         cell_idx, cell_w, buckets, contrib=contrib,
         init=lambda nb: (jnp.zeros((nb, fout), jnp.float32),
                          jnp.zeros((nb,), jnp.float32)),
-        slot_bytes=lambda nb: nb * (fout + lanes) * 4)
+        slot_bytes=slot_bytes)
     ns = [o[0] for o in outs]
     ds = [o[1] for o in outs]
     n_out = ns[0] if len(ns) == 1 else jnp.concatenate(ns, axis=0)
     d_out = ds[0] if len(ds) == 1 else jnp.concatenate(ds)
-    tmask = (ctail_w > 0).astype(jnp.float32)
-    tn = jnp.take(table_f, ctail_src, axis=0) * tmask[:, None]
+    tn, td = contrib(ctail_src, ctail_w)
     n_out = n_out.at[ctail_dst].add(tn)
-    td = jnp.take(table_b, ctail_src, axis=0).sum(axis=-1) * (tmask / lanes)
     d_out = d_out + jax.ops.segment_sum(td, ctail_dst, num_segments=b,
                                         indices_are_sorted=True)
     return n_out, d_out
 
 
+def _mask_slot_pass(table_f, table_b, cell_idx, cell_w, ctail_dst, ctail_src,
+                    ctail_w, buckets, b):
+    """Masked Σ over in-edge slots of ``(table_f[src], table_b[src])`` —
+    feature rows plus a lane-broadcast scalar table consumed by row-sum.
+
+    Returns ``(N, D)``: (b, f) feature sums and (b,) scalar sums.
+    """
+    fout = table_f.shape[-1]
+    lanes = table_b.shape[-1]
+
+    def contrib(idx, wv):
+        mask = (wv > 0).astype(jnp.float32)
+        n = jnp.take(table_f, idx, axis=0).astype(jnp.float32) \
+            * mask[:, None]
+        # row-sum consumes every lane of the broadcast tile: the gather
+        # stays a fast full-tile fetch (slicing one lane would let XLA
+        # narrow it onto the 3.2×-slower sub-tile path)
+        d = jnp.take(table_b, idx, axis=0).astype(jnp.float32).sum(axis=-1) \
+            * (mask / lanes)
+        return n, d
+
+    return _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w,
+                      buckets, b, fout, contrib,
+                      slot_bytes=lambda nb: nb * (fout + lanes) * 4)
+
+
 _BCAST_LANES = 128
+
+
+def _pack_rows(x16):
+    """(B, f) bf16 → (B, f/2) f32 by bit-pairing adjacent lanes."""
+    b, f = x16.shape
+    return jax.lax.bitcast_convert_type(
+        x16.reshape(b, f // 2, 2), jnp.float32)
+
+
+def _unpack_rows(xp, f):
+    """(B, f/2) f32 → (B, f) bf16 (inverse of ``_pack_rows``)."""
+    return jax.lax.bitcast_convert_type(xp, jnp.bfloat16).reshape(
+        xp.shape[0], f)
+
+
+def _packed_aggregate(rows16, scalar, fout, send_idx, halo_src, cell_idx,
+                      cell_w, ctail_dst, ctail_src, ctail_w, buckets, b,
+                      axis_name):
+    """Masked Σ over in-edges of ``(rows16[src], scalar[src])`` — ONE gather
+    per edge: the bf16 feature row bit-packs into ``fout/2`` f32 lanes and
+    the scalar rides the next lane, so the whole (fout/2 + 1)-wide gathered
+    row stays inside one 128-lane tile for fout ≤ 254 (the v5e gather drops
+    3.2× past one tile).  Exchange ships the same packed table: half the
+    ICI bytes of the f32 path.  Used by the bf16 compute path; masked slots
+    contribute exactly 0 either way."""
+    half = fout // 2
+    table = jnp.concatenate([_pack_rows(rows16), scalar[:, None]], axis=-1)
+    halo = halo_exchange(table, send_idx, halo_src, axis_name)
+    full = jnp.concatenate([table, halo], axis=0)     # (B+R, half+1)
+
+    def contrib(idx, wv):
+        mask = (wv > 0).astype(jnp.float32)
+        g = jnp.take(full, idx, axis=0)               # (nb, half+1)
+        rows = _unpack_rows(g[:, :half], fout).astype(jnp.float32)
+        return rows * mask[:, None], g[:, half] * mask
+
+    return _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w,
+                      buckets, b, fout, contrib,
+                      slot_bytes=lambda nb: nb * (half + 1 + fout) * 4)
+
+
+def _use_packed(dtype, fout: int) -> bool:
+    return dtype == jnp.bfloat16 and fout % 2 == 0
 
 
 def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
@@ -259,17 +311,28 @@ def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
     # global stabilizer over REAL rows only: pad rows carry z2 = 0, which
     # would floor the max at 0 and turn the underflow guard into an absolute
     # threshold instead of the documented relative-spread limit
-    z2m = jnp.where(row_valid > 0, z2, -jnp.inf)
+    z2m = jnp.where(row_valid > 0, z2.astype(jnp.float32), -jnp.inf)
     cg = jax.lax.pmax(jnp.max(z2m), axis_name)
-    u = jnp.exp(z2 - cg)                             # (B,) in (0, 1]
-    p = u[:, None] * z                               # (B, fout)
-    table = jnp.concatenate([p, u[:, None]], axis=-1)
-    halo = halo_exchange(table, send_idx, halo_src, axis_name)
-    full_p = jnp.concatenate([p, halo[:, :fout]], axis=0)     # (B+R, fout)
-    full_u = jnp.concatenate([u, halo[:, fout]])              # (B+R,)
-    ub = jnp.broadcast_to(full_u[:, None], (full_u.shape[0], _BCAST_LANES))
-    num, den = _mask_slot_pass(full_p, ub, cell_idx, cell_w, ctail_dst,
-                               ctail_src, ctail_w, buckets, b)
+    u = jnp.exp(z2.astype(jnp.float32) - cg)         # (B,) in (0, 1]
+    if _use_packed(z.dtype, fout):
+        # bf16 compute: ONE gather per edge carries [u·z ‖ u] bit-packed
+        p16 = u.astype(jnp.bfloat16)[:, None] * z
+        num, den = _packed_aggregate(
+            p16, u, fout, send_idx, halo_src, cell_idx, cell_w,
+            ctail_dst, ctail_src, ctail_w, buckets, b, axis_name)
+    else:
+        # table stays in the compute dtype (bf16 under mixed precision,
+        # halving exchange bytes); u itself is f32 for stabilizer exactness
+        p = u.astype(z.dtype)[:, None] * z           # (B, fout)
+        table = jnp.concatenate([p, u.astype(z.dtype)[:, None]], axis=-1)
+        halo = halo_exchange(table, send_idx, halo_src, axis_name)
+        full_p = jnp.concatenate([p, halo[:, :fout]], axis=0)  # (B+R, fout)
+        full_u = jnp.concatenate([u.astype(z.dtype),
+                                  halo[:, fout]])              # (B+R,)
+        ub = jnp.broadcast_to(full_u[:, None],
+                              (full_u.shape[0], _BCAST_LANES))
+        num, den = _mask_slot_pass(full_p, ub, cell_idx, cell_w, ctail_dst,
+                                   ctail_src, ctail_w, buckets, b)
     # max(den, tiny): u > 0 for every real edge, so this stays exact until
     # genuine f32 underflow (~68-nat spread); an ABSOLUTE eps would zero
     # rows whose neighborhoods sit merely ~20 nats below the global max.
@@ -301,13 +364,21 @@ def _gat_layer_sym_bwd(buckets, axis_name, res, gbar):
     dd = -(gbar * out).sum(axis=-1) / dng            # (B,)
     # transpose of a symmetric pattern = the same aggregation: for src row
     # j, Σ_i mask_ij·dn_i over j's in-edge slots (aggregators of j)
-    table = jnp.concatenate([dn, dd[:, None]], axis=-1)
-    halo = halo_exchange(table, send_idx, halo_src, axis_name)
-    full_dn = jnp.concatenate([dn, halo[:, :fout]], axis=0)
-    full_dd = jnp.concatenate([dd, halo[:, fout]])
-    ddb = jnp.broadcast_to(full_dd[:, None], (full_dd.shape[0], _BCAST_LANES))
-    dp, du_agg = _mask_slot_pass(full_dn, ddb, cell_idx, cell_w, ctail_dst,
-                                 ctail_src, ctail_w, buckets, b)
+    if _use_packed(z.dtype, fout):
+        dp, du_agg = _packed_aggregate(
+            dn.astype(jnp.bfloat16), dd, fout, send_idx, halo_src,
+            cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets, b,
+            axis_name)
+    else:
+        table = jnp.concatenate([dn, dd[:, None]], axis=-1)
+        halo = halo_exchange(table, send_idx, halo_src, axis_name)
+        full_dn = jnp.concatenate([dn, halo[:, :fout]], axis=0)
+        full_dd = jnp.concatenate([dd, halo[:, fout]])
+        ddb = jnp.broadcast_to(full_dd[:, None],
+                               (full_dd.shape[0], _BCAST_LANES))
+        dp, du_agg = _mask_slot_pass(full_dn, ddb, cell_idx, cell_w,
+                                     ctail_dst, ctail_src, ctail_w,
+                                     buckets, b)
     # p = u·z, u = exp(z2 − C): chain rules (C is a pmax — constant a.e.)
     dz = u[:, None] * dp
     du = (dp * z).sum(axis=-1) + du_agg

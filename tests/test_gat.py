@@ -144,3 +144,33 @@ def test_gat_sym_backward_matches_autodiff(ahat):
     # dh is vertex-sharded (no replication), so it must match per chip
     np.testing.assert_allclose(np.asarray(g_sym[3]), np.asarray(g_auto[3]),
                                rtol=2e-4, atol=2e-5, err_msg="h")
+
+
+def test_gat_bf16_packed_tracks_f32(ahat):
+    """bf16 compute takes the bit-packed one-gather-per-edge aggregation;
+    trajectory must track the f32 path within bf16 tolerance."""
+    n = ahat.shape[0]
+    rng = np.random.default_rng(6)
+    feats = rng.standard_normal((n, 8)).astype(np.float32)
+    labels = rng.integers(0, 3, size=n).astype(np.int32)
+    pv = balanced_random_partition(n, 4, seed=5)
+    plan = build_comm_plan(ahat, pv, 4)
+    from sgcn_tpu.train import make_train_data
+    data = make_train_data(plan, feats, labels)
+    # widths even (packing pairs lanes); seed shared
+    f32 = FullBatchTrainer(plan, fin=8, widths=[6, 3 + 1], seed=2,
+                           model="gat", activation="none")
+    b16 = FullBatchTrainer(plan, fin=8, widths=[6, 3 + 1], seed=2,
+                           model="gat", activation="none",
+                           compute_dtype="bfloat16")
+    l32 = [f32.step(data) for _ in range(5)]
+    l16 = [b16.step(data) for _ in range(5)]
+    np.testing.assert_allclose(l16, l32, rtol=0.05, atol=0.03)
+    assert l16[-1] < l16[0]
+    # odd layer width: falls back to the two-pass form, which must keep the
+    # exchange table in the compute dtype (not silently promote to f32)
+    odd = FullBatchTrainer(plan, fin=8, widths=[6, 3], seed=2,
+                           model="gat", activation="none",
+                           compute_dtype="bfloat16")
+    lo = [odd.step(data) for _ in range(3)]
+    assert np.isfinite(lo).all() and lo[-1] < lo[0]
