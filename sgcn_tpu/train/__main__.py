@@ -74,6 +74,12 @@ def main() -> None:
                         "planetoid split and report test accuracy for each")
     p.add_argument("--train-per-class", type=int, default=20,
                    help="planetoid split: train nodes per class")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the training run "
+                        "into DIR (view with TensorBoard / xprof; the "
+                        "reference's analogue is its manual phase timers, "
+                        "Cagnet/main.c:35-38 — see utils/timers.py for "
+                        "those)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -159,32 +165,40 @@ def main() -> None:
         from .accuracy import run_accuracy_parity
         train_mask, test_mask = planetoid_split(
             labels, per_class=args.train_per_class, seed=args.seed)
-        report = run_accuracy_parity(
-            a, feats, labels, pv, k, widths, train_mask, test_mask,
-            epochs=args.epochs, batch_size=args.batch_size, lr=args.lr,
-            seed=args.seed)
+        import contextlib
+        prof = (jax.profiler.trace(args.profile) if args.profile
+                else contextlib.nullcontext())
+        with prof:
+            report = run_accuracy_parity(
+                a, feats, labels, pv, k, widths, train_mask, test_mask,
+                epochs=args.epochs, batch_size=args.batch_size, lr=args.lr,
+                seed=args.seed)
         report["experiment"] = "accuracy"
         report["backend"] = args.backend
         if ctx.is_coordinator:
             print(json.dumps(report), flush=True)
         return
 
-    if args.batch_size is not None:
-        tr = MiniBatchTrainer(a, pv, k, fin=f, widths=widths,
-                              batch_size=args.batch_size, lr=args.lr,
-                              model=args.model, loss=args.loss,
-                              activation=activation, seed=args.seed,
-                              compute_dtype=args.dtype)
-        report = tr.fit(feats, labels, epochs=args.epochs,
-                        warmup=args.warmup)
-    else:
-        plan = build_comm_plan(a, pv, k)
-        tr = FullBatchTrainer(plan, fin=f, widths=widths, lr=args.lr,
-                              model=args.model, loss=args.loss,
-                              activation=activation, seed=args.seed,
-                              compute_dtype=args.dtype)
-        data = make_train_data(plan, feats, labels)
-        report = tr.fit(data, epochs=args.epochs, warmup=args.warmup)
+    import contextlib
+    prof = (jax.profiler.trace(args.profile) if args.profile
+            else contextlib.nullcontext())
+    with prof:
+        if args.batch_size is not None:
+            tr = MiniBatchTrainer(a, pv, k, fin=f, widths=widths,
+                                  batch_size=args.batch_size, lr=args.lr,
+                                  model=args.model, loss=args.loss,
+                                  activation=activation, seed=args.seed,
+                                  compute_dtype=args.dtype)
+            report = tr.fit(feats, labels, epochs=args.epochs,
+                            warmup=args.warmup)
+        else:
+            plan = build_comm_plan(a, pv, k)
+            tr = FullBatchTrainer(plan, fin=f, widths=widths, lr=args.lr,
+                                  model=args.model, loss=args.loss,
+                                  activation=activation, seed=args.seed,
+                                  compute_dtype=args.dtype)
+            data = make_train_data(plan, feats, labels)
+            report = tr.fit(data, epochs=args.epochs, warmup=args.warmup)
 
     # rank-0-style end-of-run line (GPU/PGCN.py:226-238)
     report["backend"] = args.backend

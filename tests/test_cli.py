@@ -128,3 +128,20 @@ def test_train_cli_rejects_bad_partvec(pipeline):
                  "-l", "2", "-f", "4"])
     assert r.returncode != 0
     assert "partvec length" in r.stderr
+
+
+def test_train_cli_profile_writes_trace(pipeline, tmp_path):
+    """--profile DIR captures a jax.profiler trace of the run (the tracing
+    half of SURVEY.md §5.1; the phase-timer half is utils/timers.py)."""
+    d = pipeline
+    prof_dir = tmp_path / "prof"
+    r = run_cli(["sgcn_tpu.train", "-a", str(d / "g.A.mtx"),
+                 "-p", str(d / "g.A.mtx.4.hp"), "-b", "cpu", "-s", "4",
+                 "-l", "2", "-f", "8", "--epochs", "2",
+                 "--profile", str(prof_dir)])
+    assert r.returncode == 0, r.stderr
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["epochs"] == 2
+    traces = list(prof_dir.rglob("*.xplane.pb")) + \
+        list(prof_dir.rglob("*.trace.json.gz"))
+    assert traces, f"no trace files under {prof_dir}"
