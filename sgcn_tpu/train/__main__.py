@@ -22,6 +22,7 @@ are deferred into ``main`` after arg parsing.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -149,6 +150,9 @@ def main() -> None:
     # PGAT stacks bare modules: no inter-layer nonlinearity unless asked
     activation = args.activation or ("none" if args.model == "gat" else "relu")
 
+    prof = (jax.profiler.trace(args.profile) if args.profile
+            else contextlib.nullcontext())
+
     if args.experiment == "accuracy":
         # the PGCN-Accuracy run (GPU/PGCN-Accuracy.py, README.md:110):
         # planetoid split, oracle vs partitioned trainers, test accuracy each.
@@ -165,9 +169,6 @@ def main() -> None:
         from .accuracy import run_accuracy_parity
         train_mask, test_mask = planetoid_split(
             labels, per_class=args.train_per_class, seed=args.seed)
-        import contextlib
-        prof = (jax.profiler.trace(args.profile) if args.profile
-                else contextlib.nullcontext())
         with prof:
             report = run_accuracy_parity(
                 a, feats, labels, pv, k, widths, train_mask, test_mask,
@@ -179,9 +180,6 @@ def main() -> None:
             print(json.dumps(report), flush=True)
         return
 
-    import contextlib
-    prof = (jax.profiler.trace(args.profile) if args.profile
-            else contextlib.nullcontext())
     with prof:
         if args.batch_size is not None:
             tr = MiniBatchTrainer(a, pv, k, fin=f, widths=widths,

@@ -127,3 +127,37 @@ def test_powerlaw_hub_widths_capped():
               * plan.ltail_w[0][:, None])
     np.testing.assert_allclose(plan.gather_rows(out[None]), ahat @ h,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_empty_part_and_fewer_vertices_than_parts():
+    """Degenerate partitions must build valid plans and train finitely:
+    a part that owns zero vertices (a real partitioner outcome on small or
+    skewed graphs) and n < k (more chips than vertices).  The reference's
+    per-rank file pipeline would simply emit empty A.r/H.r files for such a
+    rank (GCN-HP/main.cpp:213-282); here the padded per-chip blocks play
+    that role."""
+    from sgcn_tpu.io.datasets import er_graph
+    from sgcn_tpu.prep import normalize_adjacency
+    from sgcn_tpu.train import FullBatchTrainer, make_train_data
+
+    rng = np.random.default_rng(0)
+
+    ahat = normalize_adjacency(er_graph(40, 4, 0))
+    pv = np.array([i % 8 for i in range(40)])
+    pv[pv == 3] = 2                       # part 3 owns nothing
+    plan = build_comm_plan(ahat, pv, 8)
+    feats = rng.standard_normal((40, 8)).astype(np.float32)
+    labels = rng.integers(0, 3, 40).astype(np.int32)
+    for kw in ({}, {"model": "gat", "activation": "none"}):
+        tr = FullBatchTrainer(plan, fin=8, widths=[8, 3], **kw)
+        data = make_train_data(plan, feats, labels)
+        losses = [float(tr.step(data)) for _ in range(2)]
+        assert np.all(np.isfinite(losses)), (kw, losses)
+
+    ahat2 = normalize_adjacency(er_graph(5, 2, 1))
+    plan2 = build_comm_plan(ahat2, np.arange(5), 8)
+    tr2 = FullBatchTrainer(plan2, fin=4, widths=[4, 2])
+    d2 = make_train_data(plan2,
+                         rng.standard_normal((5, 4)).astype(np.float32),
+                         np.array([0, 1, 0, 1, 0], np.int32))
+    assert np.isfinite(float(tr2.step(d2)))
