@@ -1,0 +1,76 @@
+"""Unit tests for the observability vocabulary (SURVEY.md §5.1/§5.5):
+CommStats' 8-number SUM/MAX report and its conservation invariants, and the
+PhaseTimer phase breakdown (the CAGNET baseline's
+data_comm/local_spmm/... accounting, Cagnet/main.c:35-38,395-413).
+
+The deeper invariant — measured trainer volume == partitioner-predicted
+connectivity — is covered end-to-end in test_minibatch/test_cli; these pin
+the counter algebra itself.
+"""
+
+import numpy as np
+
+from sgcn_tpu.io.datasets import er_graph
+from sgcn_tpu.parallel import build_comm_plan
+from sgcn_tpu.partition import balanced_random_partition
+from sgcn_tpu.prep import normalize_adjacency
+from sgcn_tpu.utils.stats import CommStats
+from sgcn_tpu.utils.timers import PhaseTimer
+
+
+def _plan(n=200, k=4, seed=0):
+    ahat = normalize_adjacency(er_graph(n, 6, seed))
+    pv = balanced_random_partition(n, k, seed=seed + 1)
+    return build_comm_plan(ahat, pv, k)
+
+
+def test_commstats_conservation_and_report():
+    plan = _plan()
+    st = CommStats.from_plan(plan)
+    # every row some rank sends, exactly one rank receives (and vice versa):
+    # global send volume == global recv volume, same for message counts
+    assert st.send_volume_per_exchange.sum() == st.recv_volume_per_exchange.sum()
+    assert st.send_msgs_per_exchange.sum() == st.recv_msgs_per_exchange.sum()
+
+    st.count_step(nlayers=3)       # 3 fwd + 3 bwd exchanges
+    st.count_forward(nlayers=2)    # inference adds fwd-only exchanges
+    assert st.exchanges == 8
+    rep = st.report()
+    per_ex = int(st.send_volume_per_exchange.sum())
+    assert rep["total_send_volume"] == 8 * per_ex
+    assert rep["total_recv_volume"] == rep["total_send_volume"]
+    assert rep["max_send_volume"] == 8 * int(st.send_volume_per_exchange.max())
+    assert set(rep) == {
+        "total_send_volume", "max_send_volume", "total_send_msgs",
+        "max_send_msgs", "total_recv_volume", "max_recv_volume",
+        "total_recv_msgs", "max_recv_msgs"}
+
+
+def test_commstats_merged_report_matches_manual_sum():
+    """merged_report = per-rank sums across batch plans first, SUM/MAX over
+    ranks second (the reference shares one counter dict across batches)."""
+    plans = [_plan(seed=s) for s in (0, 1)]
+    stats = [CommStats.from_plan(p) for p in plans]
+    stats[0].count_step(nlayers=2)
+    stats[1].count_step(nlayers=2)
+    stats[1].count_step(nlayers=2)
+    merged = CommStats.merged_report(stats)
+    sv = (stats[0].send_volume_per_exchange * stats[0].exchanges
+          + stats[1].send_volume_per_exchange * stats[1].exchanges)
+    assert merged["total_send_volume"] == int(sv.sum())
+    assert merged["max_send_volume"] == int(sv.max())
+
+
+def test_phase_timer_breakdown():
+    t = PhaseTimer()
+    for _ in range(3):
+        with t.phase("data_comm"):
+            pass
+    with t.phase("local_spmm", sync=lambda: np.zeros(1)):
+        pass
+    rep = t.report()
+    assert rep["data_comm"]["count"] == 3
+    assert rep["local_spmm"]["count"] == 1
+    assert rep["local_spmm"]["total_s"] >= 0
+    np.testing.assert_allclose(
+        rep["data_comm"]["avg_s"], rep["data_comm"]["total_s"] / 3)
