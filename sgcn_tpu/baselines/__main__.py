@@ -1,0 +1,125 @@
+"""Baseline CLIs — the reference's two comparison executables.
+
+  * ``python -m sgcn_tpu.baselines oracle -a A.mtx -f H.mtx -y Y.mtx -c config``
+    — the DGL single-process GCN role (``DGL/gcn.py``; reference flags
+    ``-a -h -y -c``, ``README.md:150-166`` — its ``-h`` is spelled ``-f``
+    here so argparse help stays usable): dense single-device training on the
+    preprocessor outputs, sigmoid between layers, SGD+momentum, per-epoch
+    loss + process time.
+  * ``python -m sgcn_tpu.baselines cagnet -a A.mtx -c config -s k``
+    — the CAGNET 1D-broadcast inference baseline role (``Cagnet/main.c``,
+    ``README.md:168-183``): uniform block row distribution, k-step
+    all-gather layer, inference only, phase-time breakdown
+    (``Cagnet/main.c:35-38,395-413``).
+
+Backend selection: ``-b cpu`` (default) forces host CPU devices via
+``sgcn_tpu.utils.backend.use_cpu_devices`` — the platform choice is applied
+with ``jax.config.update`` because running under ``-m`` executes the package
+``__init__`` (which imports jax) before this file's body; backend init is
+lazy, so the update still lands first.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _add_common(p):
+    p.add_argument("-a", "--adjacency", required=True,
+                   help="path to <name>.A.mtx (normalized adjacency)")
+    p.add_argument("-c", "--config", default=None,
+                   help="config sidecar 'nlayers nvtx f1 ... nout'; widths "
+                        "default to it when present")
+    p.add_argument("-f", "--features-mtx", default=None,
+                   help="path to <name>.H.mtx (the reference DGL CLI's -h)")
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="sgcn_tpu comparison baselines")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    po = sub.add_parser("oracle", help="DGL/gcn.py role: dense single-device "
+                                       "GCN on preprocessor outputs")
+    _add_common(po)
+    po.add_argument("-y", "--labels-mtx", default=None,
+                    help="path to <name>.Y.mtx (one-hot labels)")
+    po.add_argument("--lr", type=float, default=0.01)
+    po.add_argument("-b", "--backend", default="cpu", choices=["jax", "cpu"],
+                    help="cpu (default) = host CPU, the single-process "
+                         "DGL-baseline deployment; jax = platform devices")
+
+    pc = sub.add_parser("cagnet", help="Cagnet/main.c role: 1D-broadcast "
+                                       "inference with phase breakdown "
+                                       "(inference-only: no lr)")
+    _add_common(pc)
+    pc.add_argument("-s", "--nparts", type=int, required=True)
+    pc.add_argument("-b", "--backend", default="cpu", choices=["jax", "cpu"])
+
+    args = p.parse_args()
+    if args.epochs < 1:
+        raise SystemExit("--epochs must be >= 1")
+
+    if args.backend == "cpu":
+        from ..utils.backend import use_cpu_devices
+        use_cpu_devices(getattr(args, "nparts", 1))
+
+    import numpy as np
+
+    from ..io.config import read_config
+    from ..io.mtx import read_dense_features, read_mtx, read_onehot_labels
+
+    a = read_mtx(args.adjacency)
+    n = a.shape[0]
+    cfg = read_config(args.config) if args.config else None
+
+    if args.features_mtx:
+        feats = read_dense_features(args.features_mtx)
+    else:
+        feats = np.ones((n, cfg.widths[0] if cfg else 16), np.float32)
+    fin = feats.shape[1]
+    widths = list(cfg.widths) if cfg else [fin, 2]
+
+    if args.cmd == "oracle":
+        from .oracle import DenseOracle
+        import optax
+        if args.labels_mtx:
+            labels = read_onehot_labels(args.labels_mtx)
+        else:
+            labels = (np.arange(n) % widths[-1]).astype(np.int32)
+        # DGL/gcn.py: sigmoid between layers, cross-entropy, SGD momentum,
+        # 5 epochs timed with time.process_time (DGL/gcn.py:74-97)
+        oracle = DenseOracle(a, fin=fin, widths=widths, activation="sigmoid",
+                             optimizer=optax.sgd(args.lr, momentum=0.9),
+                             seed=args.seed)
+        t0 = time.process_time()
+        losses = oracle.fit(feats, labels, epochs=args.epochs)
+        for e, l in enumerate(losses):
+            print(f"epoch {e}: loss {l:.6f}", file=sys.stderr, flush=True)
+        print(json.dumps({
+            "baseline": "oracle",
+            "epochs": args.epochs,
+            "process_time_s": time.process_time() - t0,
+            "final_loss": losses[-1],
+        }), flush=True)
+        return
+
+    from .cagnet1d import BroadcastGCN1D
+    k = args.nparts
+    # CAGNET's uniform block row distribution (Cagnet/main.c: contiguous
+    # equal blocks; no partitioner)
+    partvec = np.repeat(np.arange(k), -(-n // k))[:n]
+    bc = BroadcastGCN1D(a, partvec, k, fin=fin, widths=widths,
+                        seed=args.seed)
+    report, _ = bc.run_epochs(feats, epochs=args.epochs)
+    report["baseline"] = "cagnet1d"
+    report["backend"] = args.backend
+    print(json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    main()

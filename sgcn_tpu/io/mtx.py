@@ -37,3 +37,15 @@ def read_mtx(path: str) -> sp.csr_matrix:
 def write_mtx(path: str, m: sp.spmatrix, comment: str = "") -> None:
     """Write CSR/COO to MatrixMarket coordinate general format (1-based)."""
     scipy.io.mmwrite(path, sp.coo_matrix(m), comment=comment, precision=8)
+
+
+def read_dense_features(path: str) -> np.ndarray:
+    """Read an ``H.mtx`` feature matrix as dense (n, f) float32 — the form
+    every trainer consumes (``GPU/PGCN.py:186-188`` builds H dense)."""
+    return np.asarray(read_mtx(path).todense(), np.float32)
+
+
+def read_onehot_labels(path: str) -> np.ndarray:
+    """Read a ``Y.mtx`` one-hot label matrix as (n,) int32 class ids
+    (the preprocessor writes one-hot rows, ``preprocess/GrB-GNN-IDG.py:76-78``)."""
+    return np.asarray(read_mtx(path).todense()).argmax(1).astype(np.int32)

@@ -24,7 +24,6 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
-import os
 import sys
 
 
@@ -85,22 +84,17 @@ def main() -> None:
     args = p.parse_args()
 
     if args.backend == "cpu":
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={args.nparts}"
-            ).strip()
+        from ..utils.backend import use_cpu_devices
+        use_cpu_devices(args.nparts)
 
     import jax
-    if args.backend == "cpu":
-        jax.config.update("jax_platforms", "cpu")
 
     from ..parallel.launch import init_distributed
     ctx = init_distributed()   # no-op single-process; SLURM/TPU-pod rendezvous otherwise
 
     import numpy as np
 
-    from ..io.mtx import read_mtx
+    from ..io.mtx import read_dense_features, read_mtx, read_onehot_labels
     from ..parallel.plan import build_comm_plan
     from ..partition.emit import read_partvec, read_partvec_pickle
     from ..prep import normalize_adjacency
@@ -130,14 +124,14 @@ def main() -> None:
 
     f = args.nfeatures
     if args.features_mtx:
-        feats = np.asarray(read_mtx(args.features_mtx).todense(), np.float32)
+        feats = read_dense_features(args.features_mtx)
     if feats is not None:
         f = feats.shape[1]
     else:
         # synthetic benchmark harness inputs (GPU/PGCN.py:186-192)
         feats = np.tile(np.arange(n, dtype=np.float32)[:, None], (1, f))
     if args.labels_mtx:
-        labels = np.asarray(read_mtx(args.labels_mtx).todense()).argmax(1)
+        labels = read_onehot_labels(args.labels_mtx)
     if labels is not None:
         nclasses = int(labels.max()) + 1
     else:

@@ -1,0 +1,24 @@
+"""CLI backend selection shared by the trainer and baseline CLIs.
+
+``-b cpu`` is the reference's Gloo "cluster on one box" mode
+(``GPU/PGCN.py:166-169``): k virtual host CPU devices standing in for k
+chips.  The XLA flag must be in the environment before XLA initializes its
+backend — package imports may already have imported ``jax`` (module import
+is fine; backend init is lazy), so the platform choice itself goes through
+``jax.config.update``, which works post-import.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def use_cpu_devices(nparts: int) -> None:
+    """Force ``nparts`` virtual host CPU devices for this process."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={nparts}"
+        ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")

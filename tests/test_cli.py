@@ -145,3 +145,31 @@ def test_train_cli_profile_writes_trace(pipeline, tmp_path):
     traces = list(prof_dir.rglob("*.xplane.pb")) + \
         list(prof_dir.rglob("*.trace.json.gz"))
     assert traces, f"no trace files under {prof_dir}"
+
+
+def test_baseline_cli_oracle(pipeline):
+    """python -m sgcn_tpu.baselines oracle = the DGL/gcn.py role: dense
+    single-process training on the preprocessor outputs (README.md:150-166)."""
+    d = pipeline
+    r = run_cli(["sgcn_tpu.baselines", "oracle", "-a", str(d / "g.A.mtx"),
+                 "-f", str(d / "g.H.mtx"), "-y", str(d / "g.Y.mtx"),
+                 "-c", str(d / "config"), "--epochs", "3"])
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["baseline"] == "oracle" and rep["epochs"] == 3
+    assert np.isfinite(rep["final_loss"])
+    assert "epoch 2" in r.stderr                   # per-epoch loss lines
+
+
+def test_baseline_cli_cagnet(pipeline):
+    """python -m sgcn_tpu.baselines cagnet = the Cagnet/main.c role:
+    uniform-block 1D broadcast inference with the phase-time breakdown
+    (Cagnet/main.c:35-38,395-413)."""
+    d = pipeline
+    r = run_cli(["sgcn_tpu.baselines", "cagnet", "-a", str(d / "g.A.mtx"),
+                 "-c", str(d / "config"), "-s", "4", "--epochs", "2"])
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["baseline"] == "cagnet1d" and rep["epochs"] == 2
+    assert {"data_comm", "local_spmm"} <= set(rep["phases"])
+    assert rep["send_volume_per_exchange"] > 0
