@@ -74,6 +74,12 @@ def main() -> None:
                         "planetoid split and report test accuracy for each")
     p.add_argument("--train-per-class", type=int, default=20,
                    help="planetoid split: train nodes per class")
+    p.add_argument("--resume", default=None, metavar="CKPT",
+                   help="restore params/opt_state from a checkpoint .npz "
+                        "before training (the reference re-randomizes every "
+                        "run, SURVEY.md §5.4 — this framework adds resume)")
+    p.add_argument("--save-checkpoint", default=None, metavar="CKPT",
+                   help="save params/opt_state after training")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the training run "
                         "into DIR (view with TensorBoard / xprof; the "
@@ -159,6 +165,11 @@ def main() -> None:
                 "--experiment accuracy compares against the dense GCN oracle "
                 "and supports only --model gcn --loss xent --activation relu "
                 "(f32); drop the conflicting flags")
+        if args.resume or args.save_checkpoint:
+            raise SystemExit(
+                "--experiment accuracy trains fresh oracle+partitioned pairs "
+                "for the parity comparison; --resume/--save-checkpoint are "
+                "not supported there")
         from ..io.datasets import planetoid_split
         from .accuracy import run_accuracy_parity
         train_mask, test_mask = planetoid_split(
@@ -181,6 +192,11 @@ def main() -> None:
                                   model=args.model, loss=args.loss,
                                   activation=activation, seed=args.seed,
                                   compute_dtype=args.dtype)
+            state = tr.inner          # checkpointable params/opt_state holder
+            start_step = 0
+            if args.resume:
+                from ..utils.checkpoint import load_checkpoint
+                start_step = load_checkpoint(state, args.resume)
             report = tr.fit(feats, labels, epochs=args.epochs,
                             warmup=args.warmup)
         else:
@@ -189,8 +205,19 @@ def main() -> None:
                                   model=args.model, loss=args.loss,
                                   activation=activation, seed=args.seed,
                                   compute_dtype=args.dtype)
+            state = tr
+            start_step = 0
+            if args.resume:
+                from ..utils.checkpoint import load_checkpoint
+                start_step = load_checkpoint(state, args.resume)
             data = make_train_data(plan, feats, labels)
             report = tr.fit(data, epochs=args.epochs, warmup=args.warmup)
+    if args.save_checkpoint and ctx.is_coordinator:
+        # coordinator-only write (multi-host ranks share the filesystem);
+        # step accumulates across chained resumes
+        from ..utils.checkpoint import save_checkpoint
+        report["checkpoint"] = save_checkpoint(state, args.save_checkpoint,
+                                               step=start_step + args.epochs)
 
     # rank-0-style end-of-run line (GPU/PGCN.py:226-238)
     report["backend"] = args.backend
