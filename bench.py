@@ -34,11 +34,19 @@ def synth_graph(n: int, avg_deg: int, seed: int = 0) -> sp.csr_matrix:
 
 
 def diff_time(make_run, lo: int, hi: int, reps: int = 5,
-              retries: int = 3) -> float:
+              retries: int = 6, estimates: int = 3) -> float:
     """The round-3 differential protocol, shared by every bench mode:
     ``make_run(nep)`` returns a zero-arg callable that runs ``nep``
     on-device epochs and returns a synced finite scalar; the per-call
-    tunnel constant (~110 ms) cancels in ``(t_hi − t_lo)/(hi − lo)``."""
+    tunnel constant (~110 ms) cancels in ``(t_hi − t_lo)/(hi − lo)``.
+
+    Reports the MEDIAN of ``estimates`` independent differentials: a single
+    differential is vulnerable to transients in either endpoint (an
+    inflated ``t_lo`` shrinks it — one such draw under-reported the
+    flagship by 1.7× in round 3; an inflated ``t_hi`` overstates it), and
+    the per-point median-of-reps cannot remove a transient spanning a whole
+    point.  Compiled programs are cached per epoch count, so the extra
+    estimates cost only run time."""
     def once(nep):
         run = make_run(nep)
         run()                                     # compile + warm, retired
@@ -51,14 +59,25 @@ def diff_time(make_run, lo: int, hi: int, reps: int = 5,
                 raise RuntimeError(f"non-finite loss {v}")
         return statistics.median(ts)
 
+    est = []
     for _ in range(retries):
         t_lo, t_hi = once(lo), once(hi)
         if t_hi > t_lo:
-            return (t_hi - t_lo) / (hi - lo)
+            est.append((t_hi - t_lo) / (hi - lo))
+            if len(est) == estimates:
+                return statistics.median(est)
+    if est:
+        # fewer clean estimates than asked: still a differential, but the
+        # robustness claim no longer holds — say so where the reader looks
+        print(f"# diff_time: only {len(est)}/{estimates} clean differential "
+              f"estimate(s) after {retries} attempts (chip contention?); "
+              "treat the reported time as a single-draw measurement",
+              file=sys.stderr)
+        return statistics.median(est)
     # never fabricate a near-zero number out of tunnel noise
     raise RuntimeError(
         f"differential timing failed: t({hi} ep)={t_hi:.4f}s <= "
-        f"t({lo} ep)={t_lo:.4f}s after {retries} attempts (chip contention?)")
+        f"t({lo} ep)={t_lo:.4f}s in every attempt (chip contention?)")
 
 
 def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
