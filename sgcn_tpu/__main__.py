@@ -1,0 +1,44 @@
+"""``python -m sgcn_tpu`` — entry-point directory for the tool family.
+
+The reference ships seven separately-built executables (SURVEY.md §1); here
+each role is a module CLI under one package.  This dispatcher only prints
+the map — each tool owns its own flags (``--help`` on any of them).
+"""
+
+from __future__ import annotations
+
+import sys
+
+_TOOLS = (
+    ("sgcn_tpu.prep", "normalize Â, emit A/H/Y.mtx + config "
+                      "(preprocess/GrB-GNN-IDG.py role)"),
+    ("sgcn_tpu.partition", "graph/hypergraph/random partitioner, part "
+                           "vectors + per-rank files (GCN-GP/GCN-HP/"
+                           "GPU partvec roles)"),
+    ("sgcn_tpu.train", "distributed full-batch / mini-batch / GAT / "
+                       "accuracy trainers (grbgcn + GPU/*.py roles)"),
+    ("sgcn_tpu.shp", "stochastic hypergraph model (GPU/SHP role)"),
+    ("sgcn_tpu.baselines", "oracle (DGL role) and cagnet (CAGNET role) "
+                           "comparison baselines"),
+)
+
+
+def main() -> int:
+    # arguments mean a mistyped tool invocation (`python -m sgcn_tpu train`
+    # instead of `python -m sgcn_tpu.train`) — fail loudly, don't no-op
+    out = sys.stderr if len(sys.argv) > 1 else sys.stdout
+    if len(sys.argv) > 1:
+        print(f"unknown arguments {sys.argv[1:]} — the tools are separate "
+              "modules:", file=out)
+    else:
+        print("sgcn_tpu — TPU-native partitioned GCN/GAT training\n",
+              file=out)
+    print("tools (run any with --help; see docs/MIGRATION.md for the "
+          "reference-command map):", file=out)
+    for mod, desc in _TOOLS:
+        print(f"  python -m {mod:22s} {desc}", file=out)
+    return 2 if len(sys.argv) > 1 else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -201,3 +201,17 @@ def test_train_cli_checkpoint_resume(pipeline, tmp_path):
     first_resumed = first_epoch_loss(r)
     first_fresh = first_epoch_loss(run_cli(base + ["--epochs", "1"]))
     assert first_resumed < first_fresh
+
+
+def test_package_dispatcher_lists_tools():
+    r = run_cli(["sgcn_tpu"])
+    assert r.returncode == 0, r.stderr
+    for mod in ("sgcn_tpu.prep", "sgcn_tpu.partition", "sgcn_tpu.train",
+                "sgcn_tpu.shp", "sgcn_tpu.baselines"):
+        assert mod in r.stdout
+
+
+def test_package_dispatcher_rejects_arguments():
+    r = run_cli(["sgcn_tpu", "train", "-a", "x.mtx"])
+    assert r.returncode == 2
+    assert "sgcn_tpu.train" in r.stderr      # points at the real module
