@@ -213,7 +213,14 @@ def spmm_ell(ell_idx, ell_w, tail_dst, tail_src, tail_w, h, buckets):
         slot_bytes=lambda nb: nb * f * 4)
     out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
     tg = jnp.take(h, tail_src, axis=0) * tail_w[:, None]
-    return out.at[tail_dst].add(tg)
+    # the tail is dst-sorted by construction (plan edges are dst-sorted and
+    # padding appends dst=b-1), so a sorted segment_sum beats the scatter-add
+    # form: measured 58.6 -> 54.0 ms/epoch (-8%) at ogbn-arxiv shape on a
+    # power-law (BA) graph where hub spill puts 8% of edges in the tail
+    # (no-op on ER benches, whose tails are empty)
+    tsum = jax.ops.segment_sum(tg, tail_dst, num_segments=out.shape[0],
+                               indices_are_sorted=True)
+    return out + tsum
 
 
 def _pspmm_ell_once(h, send_idx, halo_src, ell_idx, ell_w,

@@ -86,3 +86,15 @@ def test_checkpoint_shape_mismatch_rejected(tmp_path):
         assert False, "expected ValueError"
     except ValueError:
         pass
+
+
+def test_ba_graph_power_law():
+    """ba_graph must produce the hub-heavy profile the bucketed layout is
+    designed around (er_graph never exercises hub spill)."""
+    from sgcn_tpu.io.datasets import ba_graph
+    a = ba_graph(5000, 5, seed=1)
+    assert (a != a.T).nnz == 0
+    deg = np.asarray(a.sum(axis=1)).ravel()
+    assert deg.max() > 10 * deg.mean()          # heavy tail
+    assert abs(deg.mean() - 10) < 3             # ~2m average degree
+    assert a.diagonal().sum() == 0
