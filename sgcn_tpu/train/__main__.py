@@ -214,10 +214,14 @@ def main() -> None:
             report = tr.fit(data, epochs=args.epochs, warmup=args.warmup)
     if args.save_checkpoint and ctx.is_coordinator:
         # coordinator-only write (multi-host ranks share the filesystem);
-        # step accumulates across chained resumes
+        # step accumulates across chained resumes.  Warm-up epochs are real
+        # optimizer steps (fit() runs them before the timed ones), so they
+        # count toward the saved step — chained --resume runs would otherwise
+        # silently accumulate unreported parameter updates.
         from ..utils.checkpoint import save_checkpoint
-        report["checkpoint"] = save_checkpoint(state, args.save_checkpoint,
-                                               step=start_step + args.epochs)
+        report["checkpoint"] = save_checkpoint(
+            state, args.save_checkpoint,
+            step=start_step + args.epochs + args.warmup)
 
     # rank-0-style end-of-run line (GPU/PGCN.py:226-238)
     report["backend"] = args.backend
