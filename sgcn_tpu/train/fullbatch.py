@@ -207,6 +207,11 @@ class FullBatchTrainer:
         self.model = model
         self.loss_name = loss
         self._loss_fn = LOSSES[loss]
+        if model == "gat" and compute_dtype == "bfloat16" and not remat:
+            # pre-flight the packed-bf16 capacity edge: a clear error beats
+            # a dead TPU worker (models/gat.py::check_gat_memory)
+            from ..models.gat import check_gat_memory
+            check_gat_memory(plan.b, len(plan.halo_src), fin, widths)
         dims = list(zip([fin] + widths[:-1], widths))
         self.params = init_fn(jax.random.PRNGKey(seed), dims)
         self.opt = optimizer if optimizer is not None else optax.adam(lr)
