@@ -43,6 +43,21 @@ def synth_graph(n: int, avg_deg: int, seed: int = 0,
 
 def diff_time(make_run, lo: int, hi: int, reps: int = 5,
               retries: int = 6, estimates: int = 3) -> float:
+    """See _diff_time_quality for the companion measurement-quality record."""
+    value, n_clean = diff_time_q(make_run, lo, hi, reps, retries, estimates)
+    _diff_time_quality["clean_estimates"] = n_clean
+    _diff_time_quality["target_estimates"] = estimates
+    return value
+
+
+# Quality of the MOST RECENT diff_time call: how many clean differential
+# estimates backed the reported median (ADVICE r3: a single-draw number must
+# be distinguishable from a median-of-3 in the emitted JSON).
+_diff_time_quality: dict = {}
+
+
+def diff_time_q(make_run, lo: int, hi: int, reps: int = 5,
+                retries: int = 6, estimates: int = 3) -> tuple[float, int]:
     """The round-3 differential protocol, shared by every bench mode:
     ``make_run(nep)`` returns a zero-arg callable that runs ``nep``
     on-device epochs and returns a synced finite scalar; the per-call
@@ -73,7 +88,7 @@ def diff_time(make_run, lo: int, hi: int, reps: int = 5,
         if t_hi > t_lo:
             est.append((t_hi - t_lo) / (hi - lo))
             if len(est) == estimates:
-                return statistics.median(est)
+                return statistics.median(est), len(est)
     if est:
         # fewer clean estimates than asked: still a differential, but the
         # robustness claim no longer holds — say so where the reader looks
@@ -81,7 +96,7 @@ def diff_time(make_run, lo: int, hi: int, reps: int = 5,
               f"estimate(s) after {retries} attempts (chip contention?); "
               "treat the reported time as a single-draw measurement",
               file=sys.stderr)
-        return statistics.median(est)
+        return statistics.median(est), len(est)
     # never fabricate a near-zero number out of tunnel noise
     raise RuntimeError(
         f"differential timing failed: t({hi} ep)={t_hi:.4f}s <= "
@@ -273,24 +288,33 @@ def bench_torch_reference(ahat, feats, labels, widths, epochs: int) -> float:
     return (time.perf_counter() - t0) / epochs
 
 
-def bench_vdev_partitioned(n: int, avg_deg: int, f: int, widths, epochs: int):
+def bench_vdev_partitioned(n: int, avg_deg: int, f: int, widths, epochs: int,
+                           graph: str = "ba"):
     """Measure the actual distributed algorithm on a virtual 8-device CPU
     mesh: hp-partitioned graph, real halo exchanges (all_to_all) every layer,
     grad psum — the paper's core protocol (GPU/PGCN.py:202-238) — even though
     this box exposes one TPU chip.  Re-execs this script in a subprocess with
     the conftest env (``__graft_entry__._virtual_mesh_env`` recipe) and parses
     its one-line JSON.  Returns {} on any child failure (the flagship number
-    must not die with the diagnostic one)."""
+    must not die with the diagnostic one).
+
+    The child graph defaults to the power-law (ba) family — the profile of
+    the real ogbn graphs — and the child partitions live with one multilevel
+    restart (SGCN_RESTARTS=1) so the partitioner fits the child's time
+    budget; the full-restart partitioner quality evidence lives in the
+    products_partition artifact instead."""
     env = dict(os.environ)
     flags = [x for x in env.get("XLA_FLAGS", "").split()
              if "xla_force_host_platform_device_count" not in x]
     flags.append("--xla_force_host_platform_device_count=8")
     env["XLA_FLAGS"] = " ".join(flags)
     env["JAX_PLATFORMS"] = "cpu"
+    env["SGCN_RESTARTS"] = "1"
     cmd = [sys.executable, os.path.abspath(__file__), "--vdev-child",
            "-n", str(n), "--avg-deg", str(avg_deg), "-f", str(f),
            "--hidden", str(widths[0]), "--classes", str(widths[-1]),
-           "-l", str(len(widths)), "-e", str(epochs), "--skip-torch"]
+           "-l", str(len(widths)), "-e", str(epochs), "--skip-torch",
+           "--graph", graph]
     try:
         proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
                               timeout=1200,
@@ -301,6 +325,7 @@ def bench_vdev_partitioned(n: int, avg_deg: int, f: int, widths, epochs: int):
         return {
             "epoch_s_8dev_cpu": child["value"],
             "n_8dev": n,
+            "graph_8dev": graph,
             "partitioner_8dev": child.get("partitioner"),
             "km1_8dev": child.get("km1"),
             "comm_volume_rows_8dev": child.get("comm_volume_rows"),
@@ -311,7 +336,47 @@ def bench_vdev_partitioned(n: int, avg_deg: int, f: int, widths, epochs: int):
         return {"epoch_s_8dev_cpu": None}
 
 
+def products_partition_block() -> dict:
+    """Products-scale partitioner evidence (VERDICT r3 item 1): the native
+    hypergraph/graph partitioners run OFFLINE on the exact products-shape
+    bench graph (2.45M vertices, 122M nnz, power-law) — a ~20-minute
+    single-core job regenerated by ``scripts/products_partition.py``, not
+    re-run inside the bench.  Surfaces the recorded km1 / wall-clock /
+    balance so every BENCH_r*.json carries the products-scale partitioner
+    numbers with provenance."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_artifacts", "products_partition.json")
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as fh:
+            rec = json.load(fh)
+        return {"products_partition_8dev": {
+            "n": rec["graph"]["n"],
+            "nnz": rec["graph"]["nnz"],
+            "k": rec["k"],
+            "km1_8dev": rec["hp"]["km1"],
+            "km1_random": rec["rp"]["km1"],
+            "hp_time_s": rec["hp"]["time_s"],
+            "hp_nnz_balance": rec["hp"]["nnz_max_over_mean"],
+            "gp_km1": rec["gp"]["km1"],
+            "gp_time_s": rec["gp"]["time_s"],
+            "source": "bench_artifacts/products_partition.json "
+                      "(offline single-core run of scripts/"
+                      "products_partition.py on the bench graph)",
+        }}
+    except Exception as e:                      # noqa: BLE001 — diagnostic path
+        print(f"# products partition artifact unreadable: {e!r}",
+              file=sys.stderr)
+        return {}
+
+
 def main() -> None:
+    # async all-to-all on TPU meshes (no-op single-chip / CPU): the halo
+    # exchange only overlaps the local slot passes when the collective is
+    # async — see sgcn_tpu/utils/backend.py and tests/test_overlap_hlo.py
+    from sgcn_tpu.utils.backend import enable_tpu_async_collectives
+    enable_tpu_async_collectives()
     p = argparse.ArgumentParser()
     p.add_argument("-n", type=int, default=169_343)      # ogbn-arxiv scale
     p.add_argument("--avg-deg", type=int, default=14)
@@ -338,8 +403,11 @@ def main() -> None:
     p.add_argument("--skip-torch", action="store_true")
     p.add_argument("--skip-vdev", action="store_true",
                    help="skip the virtual-8-device partitioned diagnostic run")
-    p.add_argument("--vdev-n", type=int, default=40_000,
+    p.add_argument("--vdev-n", type=int, default=120_000,
                    help="graph size for the virtual-8-device run (CPU-bound)")
+    p.add_argument("--vdev-graph", default="ba", choices=["er", "ba"],
+                   help="graph family for the virtual-8-device run "
+                        "(default ba: the ogbn-like power-law profile)")
     p.add_argument("--vdev-child", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args()
 
@@ -369,6 +437,7 @@ def main() -> None:
             "value": round(mb_s, 6),
             "unit": "s",
             "graph": args.graph,
+            "measurement": dict(_diff_time_quality),
             **mb_metrics,
         }))
         return
@@ -376,6 +445,7 @@ def main() -> None:
     epoch_s, part_metrics = bench_jax(ahat, feats, labels, widths, args.epochs,
                                       model=args.model, dtype=args.dtype,
                                       remat=args.remat)
+    flagship_quality = dict(_diff_time_quality)   # before later diff_time calls
     if args.model == "gat":
         args.skip_torch = True          # yardsticks below are GCN-shaped
         args.skip_vdev = True
@@ -398,7 +468,19 @@ def main() -> None:
     vdev_metrics = {}
     if not (args.skip_vdev or args.vdev_child):
         vdev_metrics = bench_vdev_partitioned(
-            args.vdev_n, args.avg_deg, args.f, widths, max(2, args.epochs // 2))
+            args.vdev_n, args.avg_deg, args.f, widths, max(2, args.epochs // 2),
+            graph=args.vdev_graph)
+    extra = {}
+    if not args.vdev_child:
+        extra.update(products_partition_block())
+    if single and args.n >= 1_000_000:
+        # the measured large-table cliff (BASELINE.md micro table): this
+        # single-chip number sits at the DEGRADED gather rate; per-chip
+        # sharding shrinks tables k-fold back toward the fast regime
+        extra["gather_rate_context"] = (
+            "1.2 GB feature table gathers at ~176 Mrows/s vs ~444 Mrows/s "
+            "at 83 MB on this chip; k-way sharding moves per-chip tables "
+            "back to the fast side (BASELINE.md)")
     print(json.dumps({
         "metric": f"fullbatch_{args.model}_epoch_time",
         "value": round(epoch_s, 6),
@@ -406,12 +488,20 @@ def main() -> None:
         "graph": args.graph,
         "vs_baseline": vs,
         "vs_torch_cpu": vs,
+        # ADVICE r3: label the yardstick — vs_baseline is measured against
+        # the reference's own compute stack (torch.sparse CPU) on THIS host;
+        # the BASELINE.json north star (<=1.2x NCCL/V100 at 8 chips) needs
+        # hardware this box does not have and is NOT what this ratio claims.
+        "vs_baseline_is": "torch-CPU reference-stack proxy on this host, "
+                          "not the V100/NCCL north star (BASELINE.json)",
         "dense_equiv_s": round(dense_s, 6)
             if dense_s and np.isfinite(dense_s) else None,
         "epoch_vs_dense": round(epoch_s / dense_s, 3)
             if dense_s and np.isfinite(dense_s) else None,
+        "measurement": flagship_quality,
         **part_metrics,
         **vdev_metrics,
+        **extra,
     }))
 
 

@@ -89,9 +89,10 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
+    from ..utils.backend import enable_tpu_async_collectives, use_cpu_devices
     if args.backend == "cpu":
-        from ..utils.backend import use_cpu_devices
         use_cpu_devices(args.nparts)
+    enable_tpu_async_collectives()   # overlap needs async all-to-all on TPU
 
     import jax
 

@@ -263,18 +263,49 @@ class FullBatchTrainer:
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss, err
 
-    def _build_step(self):
+    def _build_step(self, mesh=None):
         def per_chip(params, opt_state, pa, h0, labels, valid):
             pa, h0, labels, valid = _unblock((pa, h0, labels, valid))
             return self._one_step(params, opt_state, pa, h0, labels, valid)
 
         smapped = jax.shard_map(
             per_chip,
-            mesh=self.mesh,
+            mesh=mesh if mesh is not None else self.mesh,
             in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
             out_specs=(P(), P(), P(), P()),
         )
         return jax.jit(smapped, donate_argnums=(0, 1))
+
+    def lower_step(self, mesh, fin: int):
+        """AOT-lower ONE train step for an arbitrary mesh — including a
+        device-less ``jax.experimental.topologies`` mesh (e.g. an 8-chip v5e
+        slice this host does not have).  Inputs are ShapeDtypeStructs shaped
+        like this trainer's live arrays, so the lowered module is exactly the
+        program ``step()`` runs, just targeted at the given topology.
+
+        Used by the overlap evidence test (``tests/test_overlap_hlo.py``) to
+        compile the real multi-chip TPU program and assert the async
+        all-to-all start/done schedule brackets the local slot passes —
+        the compiled-schedule form of the reference's Irecv/compute/Waitany
+        overlap (``Parallel-GCN/main.c:238-299``) that does not need 8
+        physical chips to demonstrate."""
+        from jax.sharding import NamedSharding
+
+        rep = NamedSharding(mesh, P())
+        shd = NamedSharding(mesh, P(AXIS))
+        k, b = self.plan.k, self.plan.b
+
+        def sds(x, sharding):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+        params = jax.tree.map(lambda x: sds(x, rep), self.params)
+        opt_state = jax.tree.map(lambda x: sds(x, rep), self.opt_state)
+        pa = jax.tree.map(lambda x: sds(x, shd), self.pa)
+        h0 = jax.ShapeDtypeStruct((k, b, fin), np.float32, sharding=shd)
+        labels = jax.ShapeDtypeStruct((k, b), np.int32, sharding=shd)
+        valid = jax.ShapeDtypeStruct((k, b), np.float32, sharding=shd)
+        return self._build_step(mesh=mesh).lower(
+            params, opt_state, pa, h0, labels, valid)
 
     def _build_multi(self, epochs: int):
         """Compile `epochs` training steps as ONE on-device fori_loop.

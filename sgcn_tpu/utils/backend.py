@@ -22,3 +22,22 @@ def use_cpu_devices(nparts: int) -> None:
         ).strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
+
+
+# The halo exchange only OVERLAPS with the local slot passes when the TPU
+# compiler emits the collective as an async start/done pair — and v5e's
+# default is a SYNCHRONOUS all-to-all (measured: the AOT-compiled 8-chip
+# step carries plain `all-to-all` ops until this flag is set, then 3 async
+# windows bracketing 83-192 compute fusions each — tests/test_overlap_hlo.py).
+# The reference's Irecv/compute/Waitany overlap (Parallel-GCN/main.c:238-299)
+# therefore NEEDS this flag on TPU; set it before XLA's backend initializes.
+ASYNC_COLLECTIVE_FLAGS = ("--xla_tpu_enable_async_all_to_all=true",)
+
+
+def enable_tpu_async_collectives() -> None:
+    """Append the async-collective XLA flags (idempotent; call before the
+    first computation — XLA reads XLA_FLAGS at backend initialization)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    add = [f for f in ASYNC_COLLECTIVE_FLAGS if f.split("=")[0] not in flags]
+    if add:
+        os.environ["XLA_FLAGS"] = " ".join([flags, *add]).strip()
