@@ -104,21 +104,35 @@ def reduce_chunks_unrolled(flat_idx, flat_w, nb, wb, h, nc):
 
 
 def diff_time(fn, args, lo=2, hi=6, reps=3):
+    """Differential fori_loop timing with the spmm_micro safeguards
+    (ADVICE r3): the gather TABLE (last arg) is extended by 8 slack rows
+    and dynamic-sliced at ``i % 8`` inside the loop, so every iteration's
+    gathers are loop-VARYING and while-loop invariant code motion cannot
+    hoist the body; the slice feeds only the gather source, NOT the scan
+    xs (a varying-offset slice reshaped into scan xs is the known
+    pathological-compile shape on this stack — see the measurement-protocol
+    notes).  The sink sums the WHOLE output so DCE cannot narrow the
+    gathers to the first chunk; that sum adds an identical ~2 ms to every
+    strategy's iteration, well under the ~200 ms bodies being compared."""
+    *rest, h = args
+    h_ext = jnp.concatenate([h, h[:8]], axis=0)
+
     def prog(nit):
         @jax.jit
-        def run(*a):
+        def run(h_ext, *a):
             def body(i, acc):
-                return acc + fn(*a)[0, 0]
+                h_i = lax.dynamic_slice(h_ext, (i % 8, 0), h.shape)
+                return acc + fn(*a, h_i).sum()
             return lax.fori_loop(0, nit, body, jnp.float32(0))
         return run
 
     def once(nit):
         run = prog(nit)
-        float(run(*args))
+        float(run(h_ext, *rest))
         ts = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            float(run(*args))
+            float(run(h_ext, *rest))
             ts.append(time.perf_counter() - t0)
         return min(ts)
 

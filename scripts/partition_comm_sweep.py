@@ -1,0 +1,80 @@
+"""Partition-quality comm sweep over the reference's k family.
+
+The reference sweeps its partitioners over whole dataset directories with
+k ∈ {1,2,3,9,27} (``GPU/graph/run.sh:1-13``) and {2,3,9,15,21,27}
+(``GPU/hypergraph/run.sh:1-13``) and judges by the self-reported cut /
+connectivity metrics.  This script is that experiment for our generators:
+for each graph family and k it partitions with hp (colnet km1), gp
+(edge-cut) and rp (random), then scores all three by the REAL comm plan's
+predicted halo volume (``build_comm_plan`` — the number the trainer will
+actually send), and writes ``bench_artifacts/partition_comm_sweep.json``.
+
+Graphs:
+  * ``cora2708``     — citation structure at cora's true shape (community
+                       structure: partitioners should crush random);
+  * ``ba40k_deg14``  — power-law, ogbn-like degree profile (weak community
+                       structure: honest modest margins);
+  * ``er40k_deg14``  — no structure at all (the floor: margins near 1).
+
+Usage: PYTHONPATH=/root/repo python scripts/partition_comm_sweep.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sgcn_tpu.io.datasets import ba_graph, cora_like, er_graph   # noqa: E402
+from sgcn_tpu.parallel import build_comm_plan                    # noqa: E402
+from sgcn_tpu.partition import (                                 # noqa: E402
+    balanced_random_partition, partition_graph, partition_hypergraph_colnet,
+)
+from sgcn_tpu.prep import normalize_adjacency                    # noqa: E402
+
+KS = (2, 3, 9, 15, 21, 27)      # GPU/hypergraph/run.sh:1-13
+
+
+def graphs():
+    a, _, _ = cora_like(n=2708, nclasses=7, vocab=1433, words_per_doc=18,
+                        avg_deg=4, seed=11)
+    yield "cora2708", normalize_adjacency(a)
+    yield "ba40k_deg14", normalize_adjacency(ba_graph(40_000, 7, seed=0))
+    yield "er40k_deg14", normalize_adjacency(er_graph(40_000, 14, seed=0))
+
+
+def main() -> None:
+    rows = []
+    for name, ahat in graphs():
+        n = ahat.shape[0]
+        for k in KS:
+            row = {"graph": name, "k": k}
+            t0 = time.time()
+            pv_h, _ = partition_hypergraph_colnet(ahat, k, seed=1)
+            row["hp_time_s"] = round(time.time() - t0, 2)
+            t0 = time.time()
+            pv_g, _ = partition_graph(ahat, k, seed=1)
+            row["gp_time_s"] = round(time.time() - t0, 2)
+            pv_r = balanced_random_partition(n, k, seed=1)
+            for mode, pv in (("hp", pv_h), ("gp", pv_g), ("rp", pv_r)):
+                row[mode] = int(build_comm_plan(ahat, pv, k)
+                                .predicted_send_volume.sum())
+            row["hp_vs_rp"] = round(row["rp"] / max(row["hp"], 1), 2)
+            row["gp_vs_hp"] = round(row["gp"] / max(row["hp"], 1), 2)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench_artifacts",
+        "partition_comm_sweep.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=1)
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()

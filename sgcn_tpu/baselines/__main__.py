@@ -34,7 +34,13 @@ def _add_common(p):
                    help="config sidecar 'nlayers nvtx f1 ... nout'; widths "
                         "default to it when present")
     p.add_argument("-f", "--features-mtx", default=None,
-                   help="path to <name>.H.mtx (the reference DGL CLI's -h)")
+                   help="path to <name>.H.mtx (the reference DGL CLI's -h). "
+                        "Without it, synthetic all-ones features are used "
+                        "at a GUESSED input width: the config sidecar "
+                        "'nlayers nvtx f1 ... nout' does not record fin, so "
+                        "-c alone defaults the input width to f1 (the first "
+                        "HIDDEN width) — pass -f whenever comparing against "
+                        "a pipeline whose H.mtx has a different input width")
     p.add_argument("--epochs", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
 

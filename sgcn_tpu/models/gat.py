@@ -108,7 +108,7 @@ def gat_layer_local(
     """
     if row_valid is None:
         row_valid = jnp.ones((h.shape[0],), jnp.float32)
-    out, _, _, _ = _gat_factored_fwd_core(
+    out, _, _, _, _ = _gat_factored_fwd_core(
         w, a2, h, send_idx, halo_src, cell_idx, cell_w,
         ctail_dst, ctail_src, ctail_w, row_valid, buckets, axis_name)
     return out
@@ -148,63 +148,188 @@ def gat_layer_sym(w, a1, a2, h, send_idx, halo_src, cell_idx, cell_w,
         320 ms online-softmax GAT epoch at ogbn-arxiv scale; this form
         benches 0.062 s).
     """
-    out, _, _, _ = _gat_factored_fwd_core(
+    out, _, _, _, _ = _gat_factored_fwd_core(
         w, a2, h, send_idx, halo_src, cell_idx, cell_w,
         ctail_dst, ctail_src, ctail_w, row_valid, buckets, axis_name)
     return out
 
 
+# Tail gathers above this size stream through a chunked scan instead of one
+# shot: a power-law graph at products scale spills ~29M hub edges past the
+# bucket width cap, and the one-shot tail gather materialized a 29.8 GB
+# (tail, fout+1 -> 256-lane-padded) temp — an instant compile-time OOM on a
+# 16 GB chip (measured round 4).  Chunking bounds the temp like the slot
+# scan bounds bucket temps.
+_TAIL_CHUNK_BYTES = 512 * 1024**2
+
+
+# GAT programs run several slot reduces back to back (num+den, fwd+bwd), so
+# each gets HALF the default scan-unroll liveness budget — one pass at the
+# full budget measured as the margin of a 264 MB products-scale OOM.
+_GAT_SCAN_LIVE = 3 * 1024**3 // 2
+
+# Row count above which the denominator pass gathers the 1-D u directly
+# instead of a (rows, 128) broadcast table (see _pair_slot_pass).
+_ONED_U_ROWS = 1_000_000
+
+
 def _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets,
-               b, fout, contrib, slot_bytes):
+               b, contrib, init, slot_bytes):
     """Shared scaffold for every masked in-edge aggregation: bucketed slot
-    reduce + hub-tail fold, parameterized by the per-slot ``contrib``
-    (which also decodes the tail — the tail IS one more masked slot)."""
+    reduce + hub-tail fold, generic over the per-slot ``contrib``'s output
+    pytree (which also decodes the tail — the tail IS one more masked
+    slot)."""
     from ..ops.pspmm import bucketed_slot_reduce
 
-    outs = bucketed_slot_reduce(
-        cell_idx, cell_w, buckets, contrib=contrib,
-        init=lambda nb: (jnp.zeros((nb, fout), jnp.float32),
-                         jnp.zeros((nb,), jnp.float32)),
-        slot_bytes=slot_bytes)
-    ns = [o[0] for o in outs]
-    ds = [o[1] for o in outs]
-    n_out = ns[0] if len(ns) == 1 else jnp.concatenate(ns, axis=0)
-    d_out = ds[0] if len(ds) == 1 else jnp.concatenate(ds)
-    tn, td = contrib(ctail_src, ctail_w)
-    n_out = n_out + jax.ops.segment_sum(tn, ctail_dst, num_segments=b,
-                                        indices_are_sorted=True)
-    d_out = d_out + jax.ops.segment_sum(td, ctail_dst, num_segments=b,
-                                        indices_are_sorted=True)
-    return n_out, d_out
+    outs = bucketed_slot_reduce(cell_idx, cell_w, buckets, contrib=contrib,
+                                init=init, slot_bytes=slot_bytes,
+                                scan_live_limit=_GAT_SCAN_LIVE)
+    if len(outs) == 1:
+        out = outs[0]
+    else:
+        out = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *outs)
+
+    t = ctail_src.shape[0]
+    if slot_bytes(t) <= _TAIL_CHUNK_BYTES:
+        tc = contrib(ctail_src, ctail_w)
+        return jax.tree.map(
+            lambda acc, x: acc + jax.ops.segment_sum(
+                x, ctail_dst, num_segments=b, indices_are_sorted=True),
+            out, tc)
+
+    # chunked tail: pad with weight-0 edges on the last (already-max) dst so
+    # each chunk stays dst-sorted, then scan chunk-wise segment-sums.  The
+    # carry IS the bucket output — fresh zero accumulators would hold
+    # another (b, fout) array live (1.17 GB at products scale) for no reason.
+    nchunks = -(-slot_bytes(t) // _TAIL_CHUNK_BYTES)
+    chunk = -(-t // nchunks)
+    pad = nchunks * chunk - t
+    cd = jnp.pad(ctail_dst, (0, pad), constant_values=b - 1)
+    cs = jnp.pad(ctail_src, (0, pad))
+    cw = jnp.pad(ctail_w, (0, pad))
+
+    def body(carry, xs):
+        d_i, s_i, w_i = xs
+        tc = contrib(s_i, w_i)
+        return jax.tree.map(
+            lambda acc, x: acc + jax.ops.segment_sum(
+                x, d_i, num_segments=b, indices_are_sorted=True),
+            carry, tc), None
+
+    out, _ = jax.lax.scan(
+        body, out,
+        (cd.reshape(nchunks, chunk), cs.reshape(nchunks, chunk),
+         cw.reshape(nchunks, chunk)))
+    return out
 
 
-def _mask_slot_pass(table_f, table_b, cell_idx, cell_w, ctail_dst, ctail_src,
+# The FUSED one-gather-per-edge form applies ONLY while the (fout+1)-lane
+# row fits one 128-lane tile.  Past a tile the micro numbers flatter it (a
+# lone 2-tile gather out-rates two 1-tile gathers at GB tables, 142 vs
+# 2×209 Mrows/s) but the REAL program pays XLA's tile padding: every
+# (x, 129) f32 array physically doubles (measured 2.34 GB for the products
+# table, "2.0x expansion"), and at products scale that padding alone tipped
+# the step from fitting to a 17.07 GB compile-time OOM.  SGCN_GAT_FUSED=0
+# forces the split form everywhere (A/B lever).
+import os as _os
+
+_FUSED_OK = _os.environ.get("SGCN_GAT_FUSED", "1") == "1"
+
+
+def _fused_form(fout: int) -> bool:
+    """One-gather-per-edge only while the (fout+1)-lane row fits one tile."""
+    return fout + 1 <= 128 and _FUSED_OK
+
+
+def _exchange_rows_scalar(p, u, send_idx, halo_src, axis_name):
+    """Exchange feature rows AND a per-row scalar without ever building a
+    ``(B, fout+1)``-lane table: the scalar rides its own (k, S) buffer
+    (second all_to_all of negligible bytes), dodging the 2× tile-padding
+    tax a 129-lane f32 array pays.  Returns the concatenated
+    ``[local; halo]`` pair ``(full_p (B+R, fout), full_u (B+R,))``."""
+    halo_p = halo_exchange(p, send_idx, halo_src, axis_name)
+    buf_u = jnp.take(u, send_idx, axis=0)                    # (k, S)
+    recv_u = jax.lax.all_to_all(buf_u, axis_name, split_axis=0,
+                                concat_axis=0)
+    halo_u = jnp.take(recv_u.reshape(-1), halo_src, axis=0)  # (R,)
+    return (jnp.concatenate([p, halo_p], axis=0),
+            jnp.concatenate([u, halo_u]))
+
+
+def _mask_slot_pass(table, fout, cell_idx, cell_w, ctail_dst, ctail_src,
                     ctail_w, buckets, b):
-    """Masked Σ over in-edge slots of ``(table_f[src], table_b[src])`` —
-    feature rows plus a lane-broadcast scalar table consumed by row-sum.
-
-    Returns ``(N, D)``: (b, f) feature sums and (b,) scalar sums.
-    """
-    fout = table_f.shape[-1]
-    lanes = table_b.shape[-1]
-
+    """FUSED masked Σ over in-edge slots of the ``(fout+1)``-wide ``[p ‖ u]``
+    table: one gather per edge; both slices of the gathered row are consumed
+    so XLA keeps a single full-row gather.  Callers use this only under
+    ``_fused_form`` (row within one tile).
+    Returns ``(N, D)``: (b, fout) feature sums and (b,) scalar sums."""
     def contrib(idx, wv):
         mask = (wv > 0).astype(jnp.float32)
-        n = jnp.take(table_f, idx, axis=0).astype(jnp.float32) \
-            * mask[:, None]
-        # row-sum consumes every lane of the broadcast tile: the gather
-        # stays a fast full-tile fetch (slicing one lane would let XLA
-        # narrow it onto the 3.2×-slower sub-tile path)
-        d = jnp.take(table_b, idx, axis=0).astype(jnp.float32).sum(axis=-1) \
-            * (mask / lanes)
-        return n, d
+        g = jnp.take(table, idx, axis=0).astype(jnp.float32)
+        return g[:, :fout] * mask[:, None], g[:, fout] * mask
 
     return _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w,
-                      buckets, b, fout, contrib,
-                      slot_bytes=lambda nb: nb * (fout + lanes) * 4)
+                      buckets, b, contrib,
+                      init=lambda nb: (jnp.zeros((nb, fout), jnp.float32),
+                                       jnp.zeros((nb,), jnp.float32)),
+                      slot_bytes=lambda nb: nb * (fout + 1) * 4)
 
 
-_BCAST_LANES = 128
+def _pair_slot_pass(full_p, full_u, fout, cell_idx, cell_w, ctail_dst,
+                    ctail_src, ctail_w, buckets, b):
+    """SPLIT masked Σ: feature-table gather + 128-lane broadcast-u gather
+    (the row-sum consumes every lane, keeping that gather a fast full-tile
+    fetch).  Taken when the fused row would cross a tile (fout ≥ 128):
+    the 2-tile row out-rates two 1-tile gathers in isolation, but every
+    129-lane f32 array physically DOUBLES under tile padding (measured
+    2.0× at products scale) and that padding tipped the step into a
+    compile-time OOM — so past one tile the split form wins end-to-end.
+
+    The two aggregations run as SEPARATE edge passes, not one combined
+    contrib: per-pass slot temps halve (one gather each), which doubles the
+    scan-unroll headroom and lets the broadcast-u table die before the next
+    pass's temps peak."""
+    def contrib_n(idx, wv):
+        mask = (wv > 0).astype(jnp.float32)
+        return jnp.take(full_p, idx, axis=0).astype(jnp.float32) \
+            * mask[:, None]
+
+    n_out = _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w,
+                       buckets, b, contrib_n,
+                       init=lambda nb: jnp.zeros((nb, fout), jnp.float32),
+                       slot_bytes=lambda nb: nb * fout * 4)
+
+    rows = full_p.shape[0]
+    if rows >= _ONED_U_ROWS:
+        # huge tables: gather the scalar u directly (1-D, no tile padding).
+        # A narrow gather runs ~1.45× slower per row than a 128-lane one
+        # (143 vs 209 Mrows/s measured at 2.45M rows), but the (rows, 128)
+        # broadcast-u table it replaces is 1.6 GB per pass at products
+        # scale — the difference between fitting and the round-4 OOMs.
+        def contrib_d(idx, wv):
+            mask = (wv > 0).astype(jnp.float32)
+            return jnp.take(full_u, idx, axis=0).astype(jnp.float32) * mask
+
+        d_out = _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w,
+                           buckets, b, contrib_d,
+                           init=lambda nb: jnp.zeros((nb,), jnp.float32),
+                           slot_bytes=lambda nb: nb * 8)
+        return n_out, d_out
+
+    # small tables: 128-lane broadcast-u gather (full-tile fetch at the fast
+    # 1-tile row rate; the row-sum consumes every lane)
+    ub = jnp.broadcast_to(full_u[:, None], (rows, 128))
+
+    def contrib_d(idx, wv):
+        mask = (wv > 0).astype(jnp.float32)
+        return jnp.take(ub, idx, axis=0).astype(jnp.float32).sum(axis=-1) \
+            * (mask / 128)
+
+    d_out = _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w,
+                       buckets, b, contrib_d,
+                       init=lambda nb: jnp.zeros((nb,), jnp.float32),
+                       slot_bytes=lambda nb: nb * 128 * 4)
+    return n_out, d_out
 
 
 def _pack_rows(x16):
@@ -242,7 +367,9 @@ def _packed_aggregate(rows16, scalar, fout, send_idx, halo_src, cell_idx,
         return rows * mask[:, None], g[:, half] * mask
 
     return _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w,
-                      buckets, b, fout, contrib,
+                      buckets, b, contrib,
+                      init=lambda nb: (jnp.zeros((nb, fout), jnp.float32),
+                                       jnp.zeros((nb,), jnp.float32)),
                       slot_bytes=lambda nb: nb * (half + 1 + fout) * 4)
 
 
@@ -276,40 +403,51 @@ def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
         # table stays in the compute dtype (bf16 under mixed precision,
         # halving exchange bytes); u itself is f32 for stabilizer exactness
         p = u.astype(z.dtype)[:, None] * z           # (B, fout)
-        table = jnp.concatenate([p, u.astype(z.dtype)[:, None]], axis=-1)
-        halo = halo_exchange(table, send_idx, halo_src, axis_name)
-        full_p = jnp.concatenate([p, halo[:, :fout]], axis=0)  # (B+R, fout)
-        full_u = jnp.concatenate([u.astype(z.dtype),
-                                  halo[:, fout]])              # (B+R,)
-        ub = jnp.broadcast_to(full_u[:, None],
-                              (full_u.shape[0], _BCAST_LANES))
-        num, den = _mask_slot_pass(full_p, ub, cell_idx, cell_w, ctail_dst,
-                                   ctail_src, ctail_w, buckets, b)
+        if _fused_form(fout):
+            table = jnp.concatenate([p, u.astype(z.dtype)[:, None]], axis=-1)
+            halo = halo_exchange(table, send_idx, halo_src, axis_name)
+            full = jnp.concatenate([table, halo], axis=0)   # (B+R, fout+1)
+            num, den = _mask_slot_pass(full, fout, cell_idx, cell_w,
+                                       ctail_dst, ctail_src, ctail_w,
+                                       buckets, b)
+        else:
+            full_p, full_u = _exchange_rows_scalar(
+                p, u.astype(z.dtype), send_idx, halo_src, axis_name)
+            num, den = _pair_slot_pass(full_p, full_u, fout, cell_idx,
+                                       cell_w, ctail_dst, ctail_src,
+                                       ctail_w, buckets, b)
     # max(den, tiny): u > 0 for every real edge, so this stays exact until
     # genuine f32 underflow (~68-nat spread); an ABSOLUTE eps would zero
     # rows whose neighborhoods sit merely ~20 nats below the global max.
     # 1e-30, not 1e-38: subnormals are flushed to zero on TPU/XLA, so a
     # sub-`tiny` guard silently becomes max(den, 0) -> 0/0 = NaN
     out = num / jnp.maximum(den, 1e-30)[:, None]
-    return out, z, u, den
+    return out, z, u, den, cg
 
 
 def _gat_layer_sym_fwd(w, a1, a2, h, send_idx, halo_src, cell_idx, cell_w,
                        ctail_dst, ctail_src, ctail_w, row_valid, buckets,
                        axis_name):
-    out, z, u, den = _gat_factored_fwd_core(
+    out, _, _, den, cg = _gat_factored_fwd_core(
         w, a2, h, send_idx, halo_src, cell_idx, cell_w,
         ctail_dst, ctail_src, ctail_w, row_valid, buckets, axis_name)
-    res = (w, a1, a2, h, z, u, den, out, send_idx, halo_src, cell_idx,
+    # z and u are NOT stored: at products scale each stored (B, fout) array
+    # is 1.25 GB and the fwd+bwd step measured 17.07 GB of HLO temps on a
+    # 16 GB chip with them resident; the backward recomputes z = h·w (one
+    # MXU matmul, ~0.4 ms at products scale — noise next to the gather
+    # streams) and u from the stored scalar stabilizer cg.
+    res = (w, a1, a2, h, cg, den, out, send_idx, halo_src, cell_idx,
            cell_w, ctail_dst, ctail_src, ctail_w)
     return out, res
 
 
 def _gat_layer_sym_bwd(buckets, axis_name, res, gbar):
-    (w, a1, a2, h, z, u, den, out, send_idx, halo_src, cell_idx, cell_w,
+    (w, a1, a2, h, cg, den, out, send_idx, halo_src, cell_idx, cell_w,
      ctail_dst, ctail_src, ctail_w) = res
     b = h.shape[0]
+    z = h @ w                                        # remat (see fwd)
     fout = z.shape[-1]
+    u = jnp.exp((z @ a2).astype(jnp.float32) - cg)
     # out = N/(D+ε): cotangents of the two aggregations, per dst row
     dng = jnp.maximum(den, 1e-30)                    # same guard as forward
     dn = gbar / dng[:, None]                         # (B, fout)
@@ -321,15 +459,18 @@ def _gat_layer_sym_bwd(buckets, axis_name, res, gbar):
             dn.astype(jnp.bfloat16), dd, fout, send_idx, halo_src,
             cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets, b,
             axis_name)
-    else:
+    elif _fused_form(fout):
         table = jnp.concatenate([dn, dd[:, None]], axis=-1)
         halo = halo_exchange(table, send_idx, halo_src, axis_name)
-        full_dn = jnp.concatenate([dn, halo[:, :fout]], axis=0)
-        full_dd = jnp.concatenate([dd, halo[:, fout]])
-        ddb = jnp.broadcast_to(full_dd[:, None],
-                               (full_dd.shape[0], _BCAST_LANES))
-        dp, du_agg = _mask_slot_pass(full_dn, ddb, cell_idx, cell_w,
+        full = jnp.concatenate([table, halo], axis=0)
+        dp, du_agg = _mask_slot_pass(full, fout, cell_idx, cell_w,
                                      ctail_dst, ctail_src, ctail_w,
+                                     buckets, b)
+    else:
+        full_dn, full_dd = _exchange_rows_scalar(
+            dn, dd, send_idx, halo_src, axis_name)
+        dp, du_agg = _pair_slot_pass(full_dn, full_dd, fout, cell_idx,
+                                     cell_w, ctail_dst, ctail_src, ctail_w,
                                      buckets, b)
     # p = u·z, u = exp(z2 − C): chain rules (C is a pmax — constant a.e.)
     dz = u[:, None] * dp

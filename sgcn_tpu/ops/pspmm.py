@@ -46,7 +46,7 @@ _SCAN_LIVE_LIMIT = 3 * 1024**3
 
 
 def bucketed_slot_reduce(flat_idx, flat_w, buckets, contrib, init,
-                         slot_bytes):
+                         slot_bytes, scan_live_limit: int | None = None):
     """Σ over width slots of ``contrib(idx_t, w_t)`` per bucket — THE shared
     memory policy for every bucketed width-major layout (GCN SpMM, GAT
     attention passes).
@@ -64,9 +64,15 @@ def bucketed_slot_reduce(flat_idx, flat_w, buckets, contrib, init,
 
     ``contrib(idx (nb,), w (nb,)) -> pytree of (nb, ...) f32 arrays``;
     ``init(nb)`` builds the matching zero pytree; ``slot_bytes(nb)``
-    estimates one slot's gather-temp bytes.  Returns the per-bucket reduced
-    pytrees in bucket order.
+    estimates one slot's gather-temp bytes.  ``scan_live_limit`` lowers the
+    scan-unroll liveness budget below the default — for callers that run
+    SEVERAL slot reduces in one program (the GAT num/den passes): at
+    products scale each pass unrolling to the full budget measured as the
+    difference between fitting and a 264 MB OOM.  Returns the per-bucket
+    reduced pytrees in bucket order.
     """
+    live_limit = (_SCAN_LIVE_LIMIT if scan_live_limit is None
+                  else scan_live_limit)
     outs = []
     off = 0
     for nb, wb in buckets:
@@ -94,7 +100,7 @@ def bucketed_slot_reduce(flat_idx, flat_w, buckets, contrib, init,
             # cap 8 measured OOM at ogbn-products f32 (16.59/15.75 GB): the
             # budget models only slot temps, and the rest of the epoch
             # program leaves < _SCAN_LIVE_LIMIT of true headroom there
-            unroll = max(1, min(4, _SCAN_LIVE_LIMIT // max(slot_bytes(nb), 1)))
+            unroll = max(1, min(4, live_limit // max(slot_bytes(nb), 1)))
             acc, _ = jax.lax.scan(body, acc0, (seg_i, seg_w), unroll=unroll)
         outs.append(acc)
         off += nb * wb

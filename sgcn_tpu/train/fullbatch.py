@@ -219,7 +219,15 @@ class FullBatchTrainer:
         self.params = replicate(self.mesh, self.params)
         self.opt_state = replicate(self.mesh, self.opt_state)
         self.last_err = None
-        self.pa = shard_stacked(self.mesh, _plan_arrays(plan, self.plan_fields))
+        arrays = _plan_arrays(plan, self.plan_fields)
+        if model == "gat":
+            # attention IGNORES Â's values (scores replace them; the layers
+            # only test w > 0), so the edge masks ship as int8 — the f32
+            # forms are ~0.6 GB of per-chip arguments at products scale,
+            # part of the round-4 OOM margin
+            for f in ("cell_w", "ctail_w"):
+                arrays[f] = (arrays[f] > 0).astype(np.int8)
+        self.pa = shard_stacked(self.mesh, arrays)
         self.stats = CommStats.from_plan(plan)
         self._step = self._build_step()
         self._eval = self._build_eval()

@@ -30,13 +30,24 @@ def use_cpu_devices(nparts: int) -> None:
 # step carries plain `all-to-all` ops until this flag is set, then 3 async
 # windows bracketing 83-192 compute fusions each — tests/test_overlap_hlo.py).
 # The reference's Irecv/compute/Waitany overlap (Parallel-GCN/main.c:238-299)
-# therefore NEEDS this flag on TPU; set it before XLA's backend initializes.
+# therefore NEEDS this option on real multi-chip TPU runs.
 ASYNC_COLLECTIVE_FLAGS = ("--xla_tpu_enable_async_all_to_all=true",)
 
 
 def enable_tpu_async_collectives() -> None:
-    """Append the async-collective XLA flags (idempotent; call before the
-    first computation — XLA reads XLA_FLAGS at backend initialization)."""
+    """Opt-in (``SGCN_ASYNC_A2A=1``): append the async-collective XLA flags
+    before XLA's backend initializes.
+
+    Opt-in rather than automatic because XLA_FLAGS acceptance is
+    runtime-dependent: this box's tunneled TPU client FATALLY rejects
+    ``xla_tpu_enable_async_all_to_all`` as an env flag (it only takes it as
+    a compile option — which is how ``tests/test_overlap_hlo.py`` proves
+    the async schedule), while pod libtpu runtimes take it from the env.
+    ``launch/tpu.slurm`` exports it for cluster runs; single-chip and CPU
+    runs have no cross-chip exchange to overlap, so missing it costs
+    nothing there."""
+    if os.environ.get("SGCN_ASYNC_A2A") != "1":
+        return
     flags = os.environ.get("XLA_FLAGS", "")
     add = [f for f in ASYNC_COLLECTIVE_FLAGS if f.split("=")[0] not in flags]
     if add:
