@@ -66,6 +66,9 @@ def gcn_forward_local(
     final_activation: str = "none",
     symmetric: bool = False,
     ell_buckets: tuple | None = None,   # static plan.ell_buckets (sym path)
+    pallas_tb: int | None = None,       # static: VMEM-kernel tile height —
+                                        # selects the Pallas aggregator
+    pallas_interpret: bool = False,     # static: interpreter mode (CPU CI)
     axis_name: str = AXIS,
 ):
     """Per-chip forward: L × (pspmm ⊗ dense matmul → activation) → (B, nout).
@@ -89,7 +92,19 @@ def gcn_forward_local(
     fact = get_activation(final_activation)
     nl = len(params)
 
-    if symmetric:
+    if symmetric and pallas_tb is not None:
+        # plan-driven kernel choice: per-chip tables fit the VMEM-resident
+        # Pallas kernel (ops/pallas_spmm.py::use_pallas_spmm) — the regime
+        # k-way sharding produces as k grows
+        from ..ops.pallas_spmm import pspmm_pallas_sym
+
+        def agg(x):
+            return pspmm_pallas_sym(
+                x, pa["send_idx"], pa["halo_src"],
+                pa["ptile_lsrc"], pa["ptile_lld"], pa["ptile_lw"],
+                pa["ptile_hsrc"], pa["ptile_hld"], pa["ptile_hw"],
+                pallas_tb, pallas_interpret, axis_name)
+    elif symmetric:
         if ell_buckets is None:
             raise ValueError(
                 "symmetric GCN forward needs the plan's static ell_buckets")

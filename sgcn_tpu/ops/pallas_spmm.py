@@ -65,14 +65,18 @@ def build_dst_tiles(edge_dst, edge_src, edge_w, num_rows: int, tb: int = 256):
     return tsrc, tld, tw, t * tb
 
 
-@partial(jax.jit, static_argnames=("tb", "interpret"))
-def spmm_pallas(tsrc, tld, tw, table, tb: int = 256, interpret: bool = False):
+@partial(jax.jit, static_argnames=("tb", "interpret", "vma"))
+def spmm_pallas(tsrc, tld, tw, table, tb: int = 256, interpret: bool = False,
+                vma: tuple | None = None):
     """Â·table via the tiled Pallas kernel.
 
     Args:
       tsrc/tld/tw: (T, Emax) tile arrays from ``build_dst_tiles``.
       table: (N, f) feature rows (local ‖ halo), f a multiple of 128 ideally.
       interpret: run in interpreter mode (CPU CI).
+      vma: mesh axis names the output varies over — REQUIRED when called
+        inside ``shard_map`` (pallas_call outputs must declare their
+        varying axes under check_vma).
 
     Returns (T·tb, f); slice to the true row count.
     """
@@ -81,6 +85,17 @@ def spmm_pallas(tsrc, tld, tw, table, tb: int = 256, interpret: bool = False):
 
     t, emax = tsrc.shape
     f = table.shape[-1]
+    if interpret:
+        # exact jnp emulation of the tile semantics — pallas interpret mode
+        # inside shard_map trips a JAX vma-analysis bug in its internal
+        # scan, and the Mosaic path is TPU-only anyway; the standalone
+        # kernel is still interpret-tested outside shard_map
+        gathered = jnp.take(table, tsrc.reshape(-1), axis=0) \
+            * tw.reshape(-1)[:, None]
+        flat_dst = (jnp.arange(t, dtype=jnp.int32)[:, None] * tb
+                    + tld).reshape(-1)
+        return jax.ops.segment_sum(gathered.astype(jnp.float32), flat_dst,
+                                   num_segments=t * tb)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,     # tsrc, tld, tw land in SMEM (scalar reads)
         grid=(t,),
@@ -107,9 +122,92 @@ def spmm_pallas(tsrc, tld, tw, table, tb: int = 256, interpret: bool = False):
         jax.lax.fori_loop(0, tsrc_pf.shape[1], body, 0)
         out_ref[:] = acc_ref[:]
 
+    out_shape = (jax.ShapeDtypeStruct((t * tb, f), jnp.float32)
+                 if vma is None else
+                 jax.ShapeDtypeStruct((t * tb, f), jnp.float32,
+                                      vma=frozenset(vma)))
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((t * tb, f), jnp.float32),
+        out_shape=out_shape,
         interpret=interpret,
     )(tsrc, tld, tw, table)
+
+
+# ------------------------------------------------- plan-driven selection
+# Per-table VMEM budget for auto-selecting this kernel.  The measured win
+# over the XLA gather path is ~1.3× while the table is VMEM-resident at a
+# few MB (round-1 measurement, module docstring); past VMEM the Mosaic
+# compile fails outright.  SGCN_PALLAS_SPMM=1 forces the choice wherever it
+# FITS (tests), =0 disables, unset/auto selects on TPU only (the win was
+# measured there; CPU interpret mode is a correctness tool, not a fast
+# path).  SGCN_PALLAS_VMEM overrides the byte budget.
+import os as _os
+
+_PALLAS_TABLE_BUDGET = int(_os.environ.get("SGCN_PALLAS_VMEM",
+                                           4 * 1024 * 1024))
+
+
+def pallas_spmm_fits(plan, fin: int, widths) -> bool:
+    """True when every layer's per-chip [local] and [halo] feature tables
+    fit the kernel's VMEM budget — the k-way-sharded regime the kernel was
+    kept for (plan.b ≈ n/k shrinks as k grows)."""
+    fmax = max([fin, *widths])
+    return (plan.b * fmax * 4 <= _PALLAS_TABLE_BUDGET
+            and plan.r * fmax * 4 <= _PALLAS_TABLE_BUDGET)
+
+
+def use_pallas_spmm(plan, fin: int, widths) -> bool:
+    import jax as _jax
+
+    env = _os.environ.get("SGCN_PALLAS_SPMM", "auto")
+    if env == "0":
+        return False
+    if not (plan.symmetric and pallas_spmm_fits(plan, fin, widths)):
+        return False
+    return env == "1" or _jax.default_backend() == "tpu"
+
+
+PALLAS_PLAN_FIELDS = ("send_idx", "halo_src", "ptile_lsrc", "ptile_lld",
+                      "ptile_lw", "ptile_hsrc", "ptile_hld", "ptile_hw")
+
+
+def _pspmm_pallas_once(h, send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw,
+                       tb, interpret, axis_name):
+    from .pspmm import halo_exchange
+
+    halo = halo_exchange(h, send_idx, halo_src, axis_name)
+    b = h.shape[0]
+    local = spmm_pallas(lsrc, lld, lw, h.astype(jnp.float32), tb=tb,
+                        interpret=interpret, vma=(axis_name,))[:b]
+    remote = spmm_pallas(hsrc, hld, hw, halo.astype(jnp.float32), tb=tb,
+                         interpret=interpret, vma=(axis_name,))[:b]
+    return (local + remote).astype(h.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+def pspmm_pallas_sym(h, send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw,
+                     tb=256, interpret=False, axis_name="v"):
+    """``pspmm_ell_sym`` with the VMEM-resident Pallas kernel as the local
+    aggregator — same overlap structure (local pass independent of the
+    exchange), same symmetric gather-only backward.  Selected by the
+    trainer via ``use_pallas_spmm`` when per-chip tables fit VMEM."""
+    return _pspmm_pallas_once(h, send_idx, halo_src, lsrc, lld, lw,
+                              hsrc, hld, hw, tb, interpret, axis_name)
+
+
+def _pspmm_pallas_sym_fwd(h, send_idx, halo_src, lsrc, lld, lw, hsrc, hld,
+                          hw, tb, interpret, axis_name):
+    out = _pspmm_pallas_once(h, send_idx, halo_src, lsrc, lld, lw,
+                             hsrc, hld, hw, tb, interpret, axis_name)
+    return out, (send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw)
+
+
+def _pspmm_pallas_sym_bwd(tb, interpret, axis_name, res, g):
+    send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw = res
+    gh = _pspmm_pallas_once(g, send_idx, halo_src, lsrc, lld, lw,
+                            hsrc, hld, hw, tb, interpret, axis_name)
+    return (gh,) + (None,) * 8
+
+
+pspmm_pallas_sym.defvjp(_pspmm_pallas_sym_fwd, _pspmm_pallas_sym_bwd)

@@ -138,6 +138,53 @@ class CommPlan:
     ctail_w: np.ndarray | None = None    # (k, CTL) float32, 0 on padding
     ctail_nnz: np.ndarray | None = None  # (k,) true combined-tail nnz
 
+    # Pallas dst-tile layout (lazy, ``ensure_pallas_tiles``): the local-src
+    # and halo-src edge families regrouped into (T, Emax) row tiles for the
+    # VMEM-resident SpMM kernel (``ops/pallas_spmm.py``) — selected by the
+    # trainer when per-chip tables fit the kernel's VMEM budget, which is
+    # exactly what k-way sharding produces as k grows.
+    pallas_tb: int | None = None          # static tile height
+    ptile_lsrc: np.ndarray | None = None  # (k, T, EmaxL) int32
+    ptile_lld: np.ndarray | None = None   # (k, T, EmaxL) int32 local dst
+    ptile_lw: np.ndarray | None = None    # (k, T, EmaxL) float32
+    ptile_hsrc: np.ndarray | None = None  # (k, T, EmaxH) int32 (halo block)
+    ptile_hld: np.ndarray | None = None   # (k, T, EmaxH) int32
+    ptile_hw: np.ndarray | None = None    # (k, T, EmaxH) float32
+
+    def ensure_pallas_tiles(self, tb: int = 256) -> "CommPlan":
+        """Build the Pallas dst-tile layout on first use.
+
+        Per chip, ``build_dst_tiles`` regroups the dst-sorted local-src and
+        halo-src edge lists into ``tb``-row tiles; Emax is then padded to
+        the max across chips so the arrays stack into the usual (k, ...)
+        sharded form.  Padding edges carry weight 0 (no-ops in the kernel).
+        """
+        if self.pallas_tb == tb and self.ptile_lsrc is not None:
+            return self
+        from ..ops.pallas_spmm import build_dst_tiles
+
+        def family(dst, src, w):
+            per = [build_dst_tiles(dst[p], src[p], w[p], self.b, tb=tb)[:3]
+                   for p in range(self.k)]
+            emax = max(x[0].shape[1] for x in per)
+
+            def padcat(i, dtype, fill):
+                return np.stack([
+                    np.pad(x[i], ((0, 0), (0, emax - x[i].shape[1])),
+                           constant_values=fill).astype(dtype)
+                    for x in per])
+
+            # pad src with 0 (weight-0), local dst with tb-1 (kernel pad row)
+            return (padcat(0, np.int32, 0), padcat(1, np.int32, tb - 1),
+                    padcat(2, np.float32, 0.0))
+
+        self.ptile_lsrc, self.ptile_lld, self.ptile_lw = family(
+            self.ledge_dst, self.ledge_src, self.ledge_w)
+        self.ptile_hsrc, self.ptile_hld, self.ptile_hw = family(
+            self.hedge_dst, self.hedge_src, self.hedge_w)
+        self.pallas_tb = tb
+        return self
+
     def ensure_cell(self, buckets: tuple | None = None,
                     ctl: int | None = None) -> "CommPlan":
         """Build the combined-edge bucketed layout on first use (GAT)."""

@@ -204,6 +204,18 @@ class FullBatchTrainer:
         init_fn, self._forward_fn, fields_fn, static_fn = MODELS[model]
         self.plan_fields = fields_fn(plan)
         self._fwd_static = static_fn(plan)   # e.g. the ELL bucket structure
+        if model == "gcn":
+            # plan-driven kernel choice (VERDICT r3 #9): per-chip tables in
+            # the VMEM regime switch the aggregator to the Pallas kernel
+            from ..ops.pallas_spmm import (PALLAS_PLAN_FIELDS,
+                                           use_pallas_spmm)
+            if use_pallas_spmm(plan, fin, widths):
+                plan.ensure_pallas_tiles()
+                self.plan_fields = PALLAS_PLAN_FIELDS
+                self._fwd_static = {
+                    "pallas_tb": plan.pallas_tb,
+                    "pallas_interpret": jax.default_backend() != "tpu",
+                }
         self.model = model
         self.loss_name = loss
         self._loss_fn = LOSSES[loss]
