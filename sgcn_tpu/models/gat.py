@@ -233,12 +233,18 @@ def _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets,
 # forces the split form everywhere (A/B lever).
 import os as _os
 
-_FUSED_OK = _os.environ.get("SGCN_GAT_FUSED", "1") == "1"
+_FUSED_MODE = _os.environ.get("SGCN_GAT_FUSED", "1")   # 0=never, 2=always
 
 
 def _fused_form(fout: int) -> bool:
-    """One-gather-per-edge only while the (fout+1)-lane row fits one tile."""
-    return fout + 1 <= 128 and _FUSED_OK
+    """One-gather-per-edge only while the (fout+1)-lane row fits one tile
+    (SGCN_GAT_FUSED: 0 forces split everywhere, 2 forces fused even past a
+    tile — A/B levers)."""
+    if _FUSED_MODE == "0":
+        return False
+    if _FUSED_MODE == "2":
+        return True
+    return fout + 1 <= 128
 
 
 def _exchange_rows_scalar(p, u, send_idx, halo_src, axis_name):
