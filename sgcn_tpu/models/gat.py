@@ -23,6 +23,7 @@ Per-chip code, meant to run inside ``shard_map`` over the 1D vertex mesh.
 
 from __future__ import annotations
 
+import os as _os
 from functools import partial
 
 import jax
@@ -159,8 +160,9 @@ def gat_layer_sym(w, a1, a2, h, send_idx, halo_src, cell_idx, cell_w,
 # bucket width cap, and the one-shot tail gather materialized a 29.8 GB
 # (tail, fout+1 -> 256-lane-padded) temp — an instant compile-time OOM on a
 # 16 GB chip (measured round 4).  Chunking bounds the temp like the slot
-# scan bounds bucket temps.
-_TAIL_CHUNK_BYTES = 512 * 1024**2
+# scan bounds bucket temps.  SGCN_GAT_TAIL_CHUNK overrides (bytes).
+_TAIL_CHUNK_BYTES = int(_os.environ.get("SGCN_GAT_TAIL_CHUNK",
+                                        256 * 1024**2))
 
 
 # GAT programs run several slot reduces back to back (num+den, fwd+bwd), so
@@ -231,8 +233,6 @@ def _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets,
 # table, "2.0x expansion"), and at products scale that padding alone tipped
 # the step from fitting to a 17.07 GB compile-time OOM.  SGCN_GAT_FUSED=0
 # forces the split form everywhere (A/B lever).
-import os as _os
-
 _FUSED_MODE = _os.environ.get("SGCN_GAT_FUSED", "1")   # 0=never, 2=always
 
 

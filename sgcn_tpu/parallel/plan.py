@@ -186,14 +186,26 @@ class CommPlan:
         return self
 
     def ensure_cell(self, buckets: tuple | None = None,
-                    ctl: int | None = None) -> "CommPlan":
-        """Build the combined-edge bucketed layout on first use (GAT)."""
+                    ctl: int | None = None,
+                    max_buckets: int | None = None) -> "CommPlan":
+        """Build the combined-edge bucketed layout on first use (GAT).
+
+        ``max_buckets`` overrides the bucket-count cap (A/B lever).  Keep
+        the default: the round-4 trace showed ~2,500 small slot gathers and
+        suggested merging buckets, but the A/B measured the 2-bucket layout
+        WORSE (18.8 s vs 15.9 s products ER GAT) — the scheduler overlaps
+        the unrolled small gathers well, and wider buckets pay real padded
+        rows.  Recorded so the next round does not retry it.
+        """
         if (self.cell_buckets is None
                 or buckets not in (None, self.cell_buckets)
                 or (ctl is not None and ctl != self.ctl)):
+            if max_buckets is None:
+                max_buckets = 6
             fields = _cell_fields(_build_ell(
                 self.edge_dst, self.edge_src, self.edge_w, self.nnz, self.b,
-                row_order=self.row_order, buckets=buckets, tl=ctl))
+                row_order=self.row_order, buckets=buckets, tl=ctl,
+                max_buckets=max_buckets))
             for name, val in fields.items():
                 setattr(self, name, val)
         return self

@@ -2,12 +2,16 @@
 
 ``python -m sgcn_tpu.partition -a A.mtx -k 8 -m hp``            → ``A.mtx.8.hp``
 ``python -m sgcn_tpu.partition -a A.mtx -k 8 -m gp,rp``         → both flavors
+``python -m sgcn_tpu.partition -a A.mtx -k 2,3,9,15,21,27 -m hp,rp``
+                                                → the reference run.sh k-sweep
 ``python -m sgcn_tpu.partition -a A.mtx -k 4 -m hp --rank-files out/ -y Y.mtx -l 2 --hidden 16``
                                                 → A.r/H.r/Y.r/conn.r/buff.r/config
 
 Reference analogues: ``GCN-HP`` (PaToH colnet + rank files), ``GCN-GP``
 (METIS + rank files), ``GPU/graph`` (METIS partvec ``.gp`` + random ``.rp``),
-``GPU/hypergraph`` (PaToH partvec ``.hp`` + ``.rp``).  A native C++ CLI with
+``GPU/hypergraph`` (PaToH partvec ``.hp`` + ``.rp``), and the batch drivers
+``GPU/{graph,hypergraph}/run.sh:1-13`` whose k-sweeps (k∈{1,2,3,9,27} /
+{2,3,9,15,21,27}) are the ``-k`` comma-list form.  A native C++ CLI with
 the same core (``native/sgcnpart``) is also built by ``make -C native``.
 """
 
@@ -27,7 +31,9 @@ from .random_part import balanced_random_partition
 def main() -> None:
     p = argparse.ArgumentParser(description="sgcn_tpu partitioner")
     p.add_argument("-a", "--adjacency", required=True)
-    p.add_argument("-k", "--nparts", type=int, required=True)
+    p.add_argument("-k", "--nparts", required=True,
+                   help="part count, or a comma list (k-sweep: the "
+                        "reference's run.sh family, e.g. 2,3,9,15,21,27)")
     p.add_argument("-m", "--modes", default="hp",
                    help="comma list of gp|hp|rp (graph/hypergraph/random)")
     p.add_argument("-e", "--imbalance", type=float, default=0.03)
@@ -44,31 +50,36 @@ def main() -> None:
     a = read_mtx(args.adjacency)
     n = a.shape[0]
     prefix = args.out_prefix or args.adjacency
-    first_pv = None
-    for mode in args.modes.split(","):
-        t0 = time.perf_counter()
-        if mode == "gp":
-            from .native import partition_graph
-            pv, metric = partition_graph(a, args.nparts, args.imbalance, args.seed)
-            mname = "edgecut"
-        elif mode == "hp":
-            from .native import partition_hypergraph_colnet
-            pv, metric = partition_hypergraph_colnet(a, args.nparts,
-                                                     args.imbalance, args.seed)
-            mname = "km1"
-        elif mode == "rp":
-            pv = balanced_random_partition(n, args.nparts, args.seed)
-            metric, mname = -1, "none"
-        else:
-            raise SystemExit(f"unknown mode {mode}")
-        dt = time.perf_counter() - t0
-        out = f"{prefix}.{args.nparts}.{mode}"
-        write_partvec(out, pv)
-        sizes = np.bincount(pv, minlength=args.nparts)
-        print(f"{mode}: {out}  {mname}={metric}  max_part={sizes.max()}  "
-              f"time_s={dt:.3f}", flush=True)
-        if first_pv is None:
-            first_pv = pv
+    try:
+        ks = [int(x) for x in str(args.nparts).split(",")]
+    except ValueError:
+        raise SystemExit(f"bad -k value {args.nparts!r}") from None
+    first_pv = first_k = None
+    for k in ks:
+        for mode in args.modes.split(","):
+            t0 = time.perf_counter()
+            if mode == "gp":
+                from .native import partition_graph
+                pv, metric = partition_graph(a, k, args.imbalance, args.seed)
+                mname = "edgecut"
+            elif mode == "hp":
+                from .native import partition_hypergraph_colnet
+                pv, metric = partition_hypergraph_colnet(a, k, args.imbalance,
+                                                         args.seed)
+                mname = "km1"
+            elif mode == "rp":
+                pv = balanced_random_partition(n, k, args.seed)
+                metric, mname = -1, "none"
+            else:
+                raise SystemExit(f"unknown mode {mode}")
+            dt = time.perf_counter() - t0
+            out = f"{prefix}.{k}.{mode}"
+            write_partvec(out, pv)
+            sizes = np.bincount(pv, minlength=k)
+            print(f"{mode}: {out}  {mname}={metric}  max_part={sizes.max()}  "
+                  f"time_s={dt:.3f}", flush=True)
+            if first_pv is None:
+                first_pv, first_k = pv, k
 
     if args.rank_files:
         import scipy.sparse as sp
@@ -76,7 +87,7 @@ def main() -> None:
         nclasses = y.shape[1]
         cfg = ModelConfig(nlayers=args.nlayers, nvtx=n,
                           widths=[args.hidden] * (args.nlayers - 1) + [nclasses])
-        write_rank_files(args.rank_files, a, y, first_pv, args.nparts, cfg)
+        write_rank_files(args.rank_files, a, y, first_pv, first_k, cfg)
         print(f"rank files → {args.rank_files}", flush=True)
 
 
