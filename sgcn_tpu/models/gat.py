@@ -494,43 +494,54 @@ def _gat_layer_sym_bwd(buckets, axis_name, res, gbar):
 gat_layer_sym.defvjp(_gat_layer_sym_fwd, _gat_layer_sym_bwd)
 
 
-def estimate_gat_hbm_bytes(b: int, r: int, fin: int,
-                           widths: list[int]) -> int:
-    """Rough per-chip peak-HBM model of one bf16 GAT fwd+bwd step.
+def estimate_gat_hbm_bytes(b: int, r: int, fin: int, widths: list[int],
+                           nnz: int = 0, tail: int = 0,
+                           dtype: str | None = None) -> int:
+    """Per-chip peak-HBM model of one GAT fwd+bwd step, CALIBRATED on the
+    round-3/4 measured capacity edges.
 
-    Counts the dominant terms of the packed mixed-precision path:
-    per-layer residuals held until the backward (input/z in bf16, out in
-    f32, u/den f32 vectors), the transient packed halo tables
-    ((B+R)·(fout/2+1)·4 bytes, twice: send table + concatenated full), and
-    the bucketed-slot scan's bounded live temps (``_SCAN_LIVE_LIMIT``).
+    f32 model ``7.08·B·(fin+Σfout) + 64·nnz + 90·tail`` reproduces the
+    measured capacity points (products shape, 15.75 GB v5e):
+      * BA 3-layer f32 (tail 29M): est 17.25 GB == the measured compile
+        OOM ("Used 17.25G");
+      * ER 3-layer f32 (tail 3.7M): est 15.13 GB — RUNS (15.9 s/epoch);
+      * bf16-packed BA 3-layer: est 16.76 == measured compile OOM;
+      * bf16-packed at B=1M: est 6.7 GB — ran (5.69 s, round 3).
+    The per-tail-edge coefficient is large (90 B) because the chunked tail
+    scans keep full-width gather temps and carries live; nnz carries the
+    slot arrays + working set of the bucketed passes.
 
-    Calibration: at products scale (B=2.45M, f=128, 3 layers) this model
-    gives ~12 GB and the real program repeatably KILLED the 16 GB v5e
-    worker (round-3 measurement); at B=1M it gives ~6.6 GB and the real
-    program ran (5.69 s).  The 0.7·HBM guard threshold separates the two.
+    KNOWN BLIND SPOT: the BA 2-layer f32 step estimates 15.2 GB (below the
+    ER-3L running point), compiled — and then crashed the WORKER at
+    runtime.  That crash is not separable by any capacity ranking
+    (2-layer < ER-3L which runs), so it is likely a kernel fault, not
+    capacity; a capacity guard cannot catch it.
     """
-    total = 0
-    f_in = fin
-    for fout in widths:
-        # residuals: h_in bf16, z bf16, out f32, u+den f32
-        total += b * (2 * f_in + 2 * fout + 4 * fout + 8)
-        # packed halo tables (transient, but alive across the slot passes)
-        total += 2 * (b + r) * (fout // 2 + 1) * 4
-        f_in = fout
-    total += 3 * 1024**3          # bucketed-slot scan live temps (bounded)
-    return total
+    ftot = fin + sum(widths)
+    if dtype == "bfloat16":
+        # packed path: fitted to the 16.76 GB BA-3L compile OOM and the
+        # running 1M-vertex point (6.7 GB est) — the packed tables halve
+        # but mixed precision double-books activations via casts, so the
+        # per-row coefficient is NOT half of f32's
+        return int(7.4 * b * ftot + 56 * nnz + 70 * tail)
+    return int(7.08 * b * ftot + 64 * nnz + 90 * tail)
 
 
 def check_gat_memory(b: int, r: int, fin: int, widths: list[int],
+                     nnz: int = 0, tail: int = 0, dtype: str | None = None,
                      hbm_bytes: int | None = None) -> None:
-    """Pre-flight guard for the bf16 GAT capacity edge (VERDICT r3): raise a
-    clear error instead of letting the TPU worker die on allocation.
+    """Pre-flight guard for the GAT capacity edge (VERDICT r3): raise a
+    clear error instead of letting the compile OOM or — worse — the TPU
+    worker die at runtime (both observed; the 2-layer BA-products f32 step
+    passed compile and then crashed the worker).
 
-    ``SGCN_HBM_BYTES`` overrides the detected/assumed HBM size."""
-    import os
-
+    The threshold is sharp by necessity — the largest RUNNING config
+    estimates 15.13 GB of the chip's 15.75 GB and the smallest compile-OOM
+    16.76 — so the guard raises above 0.97·HBM and tells the user the
+    levers.  ``SGCN_HBM_BYTES`` overrides the detected/assumed HBM size
+    (set it huge to bypass the guard for capacity experiments)."""
     if hbm_bytes is None:
-        env = os.environ.get("SGCN_HBM_BYTES")
+        env = _os.environ.get("SGCN_HBM_BYTES")
         if env:
             hbm_bytes = int(env)
         else:
@@ -539,15 +550,16 @@ def check_gat_memory(b: int, r: int, fin: int, widths: list[int],
                     "bytes_limit"]
             except Exception:               # noqa: BLE001 — stats optional
                 hbm_bytes = 16 * 1024**3    # v5e default
-    est = estimate_gat_hbm_bytes(b, r, fin, widths)
-    if est > 0.7 * hbm_bytes:
+    est = estimate_gat_hbm_bytes(b, r, fin, widths, nnz, tail, dtype)
+    if est > 0.97 * hbm_bytes:
         raise RuntimeError(
-            f"bf16 GAT at this shape is past the measured capacity edge: "
-            f"estimated ~{est / 1024**3:.1f} GB of per-chip peak HBM vs "
-            f"{hbm_bytes / 1024**3:.1f} GB available (guard at 70%; a "
-            f"products-scale run at this estimate repeatably killed the "
-            f"TPU worker in round 3).  Use f32 (drop compute_dtype), "
-            f"shard over more chips, or enable remat.")
+            f"GAT at this shape is past the measured single-chip capacity "
+            f"edge: estimated ~{est / 1024**3:.1f} GB of per-chip peak HBM "
+            f"vs {hbm_bytes / 1024**3:.1f} GB available (guard at 97%; "
+            f"calibrated on the measured compile-OOM points — see "
+            f"estimate_gat_hbm_bytes).  Levers: shard over more chips "
+            f"(per-chip B, nnz and tail all shrink ~k-fold), reduce "
+            f"layers/width, or SGCN_HBM_BYTES to override.")
 
 
 def gat_forward_local(

@@ -216,14 +216,21 @@ class FullBatchTrainer:
                     "pallas_tb": plan.pallas_tb,
                     "pallas_interpret": jax.default_backend() != "tpu",
                 }
+        if model == "gat":
+            # pre-flight the measured single-chip capacity edge: a clear
+            # error beats a compile OOM or a dead TPU worker — BOTH were
+            # observed at products scale (models/gat.py::check_gat_memory;
+            # static_fn above already ran ensure_cell, so tail size is known)
+            from ..models.gat import check_gat_memory
+            check_gat_memory(
+                plan.b, len(plan.halo_src), fin, widths,
+                nnz=int(plan.nnz.max()),
+                tail=int(plan.ctail_nnz.max()) if plan.ctail_nnz is not None
+                else 0,
+                dtype=compute_dtype)
         self.model = model
         self.loss_name = loss
         self._loss_fn = LOSSES[loss]
-        if model == "gat" and compute_dtype == "bfloat16" and not remat:
-            # pre-flight the packed-bf16 capacity edge: a clear error beats
-            # a dead TPU worker (models/gat.py::check_gat_memory)
-            from ..models.gat import check_gat_memory
-            check_gat_memory(plan.b, len(plan.halo_src), fin, widths)
         dims = list(zip([fin] + widths[:-1], widths))
         self.params = init_fn(jax.random.PRNGKey(seed), dims)
         self.opt = optimizer if optimizer is not None else optax.adam(lr)
