@@ -539,7 +539,10 @@ def check_gat_memory(b: int, r: int, fin: int, widths: list[int],
     estimates 15.13 GB of the chip's 15.75 GB and the smallest compile-OOM
     16.76 — so the guard raises above 0.97·HBM and tells the user the
     levers.  ``SGCN_HBM_BYTES`` overrides the detected/assumed HBM size
-    (set it huge to bypass the guard for capacity experiments)."""
+    (set it huge to bypass the guard for capacity experiments);
+    ``SGCN_GAT_UNSAFE=1`` skips both guards outright."""
+    if _os.environ.get("SGCN_GAT_UNSAFE") == "1":
+        return
     if hbm_bytes is None:
         env = _os.environ.get("SGCN_HBM_BYTES")
         if env:
@@ -550,6 +553,18 @@ def check_gat_memory(b: int, r: int, fin: int, widths: list[int],
                     "bytes_limit"]
             except Exception:               # noqa: BLE001 — stats optional
                 hbm_bytes = 16 * 1024**3    # v5e default
+    # Secondary fence for the runtime-crash blind spot: the 2-layer BA
+    # products step (tail 29M) passed both compile and this capacity model
+    # and then KILLED the worker, while an 11.9M-tail run (B=1M) was fine —
+    # so huge hub tails are fenced outright until the fault is understood.
+    if tail > 20_000_000:
+        raise RuntimeError(
+            f"GAT hub tail of {tail / 1e6:.1f}M edges exceeds the measured "
+            f"single-chip safety fence (20M): a products-scale run with a "
+            f"29M-edge tail crashed the TPU worker AT RUNTIME despite "
+            f"fitting the capacity model, while 11.9M ran fine.  Shard "
+            f"over more chips (the per-chip tail shrinks ~k-fold) or set "
+            f"SGCN_GAT_UNSAFE=1 to bypass both guards knowingly.")
     est = estimate_gat_hbm_bytes(b, r, fin, widths, nnz, tail, dtype)
     if est > 0.97 * hbm_bytes:
         raise RuntimeError(
