@@ -351,7 +351,7 @@ def products_partition_block() -> dict:
     try:
         with open(path) as fh:
             rec = json.load(fh)
-        return {"products_partition_8dev": {
+        block = {
             "n": rec["graph"]["n"],
             "nnz": rec["graph"]["nnz"],
             "k": rec["k"],
@@ -364,7 +364,17 @@ def products_partition_block() -> dict:
             "source": "bench_artifacts/products_partition.json "
                       "(offline single-core run of scripts/"
                       "products_partition.py on the bench graph)",
-        }}
+        }
+        if "plan_send_rows_per_pass" in rec["hp"]:
+            # the REAL 8-chip comm plan built under the saved partvec
+            # (scripts/products_plan_volume.py); equals km1 exactly — the
+            # plan-volume invariant verified at products scale
+            block["plan_send_rows_per_pass"] = \
+                rec["hp"]["plan_send_rows_per_pass"]
+            block["plan_messages_per_pass"] = \
+                rec["hp"]["plan_messages_per_pass"]
+            block["plan_b_per_chip"] = rec["hp"]["plan_b"]
+        return {"products_partition_8dev": block}
     except Exception as e:                      # noqa: BLE001 — diagnostic path
         print(f"# products partition artifact unreadable: {e!r}",
               file=sys.stderr)

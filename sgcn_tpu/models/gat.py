@@ -500,6 +500,11 @@ def estimate_gat_hbm_bytes(b: int, r: int, fin: int, widths: list[int],
     """Per-chip peak-HBM model of one GAT fwd+bwd step, CALIBRATED on the
     round-3/4 measured capacity edges.
 
+    ``r`` (true per-chip halo rows) is currently unused: every calibration
+    point is single-chip (r=0), so a halo coefficient would be a guess.
+    Callers pass the real value (``plan.halo_counts.max()``) so a fitted
+    term can be added the moment multi-chip capacity data exists.
+
     f32 model ``7.08·B·(fin+Σfout) + 64·nnz + 90·tail`` reproduces the
     measured capacity points (products shape, 15.75 GB v5e):
       * BA 3-layer f32 (tail 29M): est 17.25 GB == the measured compile

@@ -223,7 +223,7 @@ class FullBatchTrainer:
             # static_fn above already ran ensure_cell, so tail size is known)
             from ..models.gat import check_gat_memory
             check_gat_memory(
-                plan.b, len(plan.halo_src), fin, widths,
+                plan.b, int(plan.halo_counts.max()), fin, widths,
                 nnz=int(plan.nnz.max()),
                 tail=int(plan.ctail_nnz.max()) if plan.ctail_nnz is not None
                 else 0,
