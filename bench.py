@@ -34,10 +34,17 @@ def synth_graph(n: int, avg_deg: int, seed: int = 0,
     ``er`` (default, the historical bench graph) has no degree tail;
     ``ba`` is preferential-attachment with a power-law tail — the profile
     of the real ogbn graphs, and the only one that exercises the
-    degree-bucket/hub-spill layout the SpMM is designed around."""
-    from sgcn_tpu.io.datasets import ba_graph, er_graph
+    degree-bucket/hub-spill layout the SpMM is designed around;
+    ``dcsbm`` adds planted communities on top of the power-law tail — the
+    only family where the partitioner can actually SHRINK the exchange
+    (BA/ER are expanders), so it is the one that shows comm-volume-driven
+    epoch differences on the multi-chip path."""
+    from sgcn_tpu.io.datasets import ba_graph, dcsbm_graph, er_graph
     if kind == "ba":
         return ba_graph(n, max(1, avg_deg // 2), seed)
+    if kind == "dcsbm":
+        return dcsbm_graph(n, ncomm=max(8, n // 12_000), avg_deg=avg_deg,
+                           seed=seed)
     return er_graph(n, avg_deg, seed)
 
 
@@ -407,7 +414,8 @@ def main() -> None:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize layer activations in the backward "
                         "(HBM-for-FLOPs trade for huge vertex counts)")
-    p.add_argument("--graph", default="er", choices=["er", "ba"],
+    p.add_argument("--graph", default="er",
+                   choices=["er", "ba", "dcsbm"],
                    help="synthetic graph family: er (no hubs) or ba "
                         "(power-law tail, the ogbn-like profile)")
     p.add_argument("--skip-torch", action="store_true")
@@ -415,7 +423,8 @@ def main() -> None:
                    help="skip the virtual-8-device partitioned diagnostic run")
     p.add_argument("--vdev-n", type=int, default=120_000,
                    help="graph size for the virtual-8-device run (CPU-bound)")
-    p.add_argument("--vdev-graph", default="ba", choices=["er", "ba"],
+    p.add_argument("--vdev-graph", default="ba",
+                   choices=["er", "ba", "dcsbm"],
                    help="graph family for the virtual-8-device run "
                         "(default ba: the ogbn-like power-law profile)")
     p.add_argument("--vdev-child", action="store_true", help=argparse.SUPPRESS)
