@@ -3,7 +3,8 @@
 Reference being matched: ``GPU/PGAT.py`` — the paper's demonstration that the
 partitioned halo exchange composes with graph attention.  Per layer the
 reference computes ``Z = H·W``, scores ``e_ij = z1_i + z2_j`` with
-``z1 = Z·a1, z2 = Z·a2``, masks by ``A > 0``, row-softmaxes, and aggregates
+``z1 = Z·a1, z2 = Z·a2``, masks by ``A > 0`` (here ``A != 0``, so
+signed-weight graphs keep their edges — ADVICE r4), row-softmaxes, and aggregates
 ``H' = attention · Z`` (``GPU/PGAT.py:137-150``); Xavier init (``:132-135``);
 gradients all-reduced like the GCN (``:152-157``).
 
@@ -160,9 +161,10 @@ def gat_layer_sym(w, a1, a2, h, send_idx, halo_src, cell_idx, cell_w,
 # bucket width cap, and the one-shot tail gather materialized a 29.8 GB
 # (tail, fout+1 -> 256-lane-padded) temp — an instant compile-time OOM on a
 # 16 GB chip (measured round 4).  Chunking bounds the temp like the slot
-# scan bounds bucket temps.  SGCN_GAT_TAIL_CHUNK overrides (bytes).
-_TAIL_CHUNK_BYTES = int(_os.environ.get("SGCN_GAT_TAIL_CHUNK",
-                                        256 * 1024**2))
+# scan bounds bucket temps.  SGCN_GAT_TAIL_CHUNK overrides (bytes); read at
+# call time so setting it after import (monkeypatch, A/B) works — ADVICE r4.
+def _tail_chunk_bytes() -> int:
+    return int(_os.environ.get("SGCN_GAT_TAIL_CHUNK", 256 * 1024**2))
 
 
 # GAT programs run several slot reduces back to back (num+den, fwd+bwd), so
@@ -192,7 +194,8 @@ def _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets,
         out = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *outs)
 
     t = ctail_src.shape[0]
-    if slot_bytes(t) <= _TAIL_CHUNK_BYTES:
+    tail_chunk = _tail_chunk_bytes()
+    if slot_bytes(t) <= tail_chunk:
         tc = contrib(ctail_src, ctail_w)
         return jax.tree.map(
             lambda acc, x: acc + jax.ops.segment_sum(
@@ -203,7 +206,7 @@ def _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets,
     # each chunk stays dst-sorted, then scan chunk-wise segment-sums.  The
     # carry IS the bucket output — fresh zero accumulators would hold
     # another (b, fout) array live (1.17 GB at products scale) for no reason.
-    nchunks = -(-slot_bytes(t) // _TAIL_CHUNK_BYTES)
+    nchunks = -(-slot_bytes(t) // tail_chunk)
     chunk = -(-t // nchunks)
     pad = nchunks * chunk - t
     cd = jnp.pad(ctail_dst, (0, pad), constant_values=b - 1)
@@ -233,16 +236,16 @@ def _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets,
 # table, "2.0x expansion"), and at products scale that padding alone tipped
 # the step from fitting to a 17.07 GB compile-time OOM.  SGCN_GAT_FUSED=0
 # forces the split form everywhere (A/B lever).
-_FUSED_MODE = _os.environ.get("SGCN_GAT_FUSED", "1")   # 0=never, 2=always
 
 
 def _fused_form(fout: int) -> bool:
     """One-gather-per-edge only while the (fout+1)-lane row fits one tile
     (SGCN_GAT_FUSED: 0 forces split everywhere, 2 forces fused even past a
-    tile — A/B levers)."""
-    if _FUSED_MODE == "0":
+    tile — A/B levers; read at call time per ADVICE r4)."""
+    mode = _os.environ.get("SGCN_GAT_FUSED", "1")   # 0=never, 2=always
+    if mode == "0":
         return False
-    if _FUSED_MODE == "2":
+    if mode == "2":
         return True
     return fout + 1 <= 128
 
@@ -270,7 +273,7 @@ def _mask_slot_pass(table, fout, cell_idx, cell_w, ctail_dst, ctail_src,
     ``_fused_form`` (row within one tile).
     Returns ``(N, D)``: (b, fout) feature sums and (b,) scalar sums."""
     def contrib(idx, wv):
-        mask = (wv > 0).astype(jnp.float32)
+        mask = (wv != 0).astype(jnp.float32)
         g = jnp.take(table, idx, axis=0).astype(jnp.float32)
         return g[:, :fout] * mask[:, None], g[:, fout] * mask
 
@@ -296,7 +299,7 @@ def _pair_slot_pass(full_p, full_u, fout, cell_idx, cell_w, ctail_dst,
     scan-unroll headroom and lets the broadcast-u table die before the next
     pass's temps peak."""
     def contrib_n(idx, wv):
-        mask = (wv > 0).astype(jnp.float32)
+        mask = (wv != 0).astype(jnp.float32)
         return jnp.take(full_p, idx, axis=0).astype(jnp.float32) \
             * mask[:, None]
 
@@ -313,7 +316,7 @@ def _pair_slot_pass(full_p, full_u, fout, cell_idx, cell_w, ctail_dst,
         # broadcast-u table it replaces is 1.6 GB per pass at products
         # scale — the difference between fitting and the round-4 OOMs.
         def contrib_d(idx, wv):
-            mask = (wv > 0).astype(jnp.float32)
+            mask = (wv != 0).astype(jnp.float32)
             return jnp.take(full_u, idx, axis=0).astype(jnp.float32) * mask
 
         d_out = _edge_pass(cell_idx, cell_w, ctail_dst, ctail_src, ctail_w,
@@ -327,7 +330,7 @@ def _pair_slot_pass(full_p, full_u, fout, cell_idx, cell_w, ctail_dst,
     ub = jnp.broadcast_to(full_u[:, None], (rows, 128))
 
     def contrib_d(idx, wv):
-        mask = (wv > 0).astype(jnp.float32)
+        mask = (wv != 0).astype(jnp.float32)
         return jnp.take(ub, idx, axis=0).astype(jnp.float32).sum(axis=-1) \
             * (mask / 128)
 
@@ -367,7 +370,7 @@ def _packed_aggregate(rows16, scalar, fout, send_idx, halo_src, cell_idx,
     full = jnp.concatenate([table, halo], axis=0)     # (B+R, half+1)
 
     def contrib(idx, wv):
-        mask = (wv > 0).astype(jnp.float32)
+        mask = (wv != 0).astype(jnp.float32)
         g = jnp.take(full, idx, axis=0)               # (nb, half+1)
         rows = _unpack_rows(g[:, :half], fout).astype(jnp.float32)
         return rows * mask[:, None], g[:, half] * mask

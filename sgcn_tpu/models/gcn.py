@@ -68,7 +68,7 @@ def gcn_forward_local(
     ell_buckets: tuple | None = None,   # static plan.ell_buckets (sym path)
     pallas_tb: int | None = None,       # static: VMEM-kernel tile height —
                                         # selects the Pallas aggregator
-    pallas_interpret: bool = False,     # static: interpreter mode (CPU CI)
+    pallas_emulate: bool = False,       # static: jnp emulation (off-TPU shard_map CI)
     axis_name: str = AXIS,
 ):
     """Per-chip forward: L × (pspmm ⊗ dense matmul → activation) → (B, nout).
@@ -103,7 +103,7 @@ def gcn_forward_local(
                 x, pa["send_idx"], pa["halo_src"],
                 pa["ptile_lsrc"], pa["ptile_lld"], pa["ptile_lw"],
                 pa["ptile_hsrc"], pa["ptile_hld"], pa["ptile_hw"],
-                pallas_tb, pallas_interpret, axis_name)
+                pallas_tb, pallas_emulate, axis_name)
     elif symmetric:
         if ell_buckets is None:
             raise ValueError(

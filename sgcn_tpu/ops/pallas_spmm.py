@@ -65,15 +65,21 @@ def build_dst_tiles(edge_dst, edge_src, edge_w, num_rows: int, tb: int = 256):
     return tsrc, tld, tw, t * tb
 
 
-@partial(jax.jit, static_argnames=("tb", "interpret", "vma"))
+@partial(jax.jit, static_argnames=("tb", "interpret", "emulate", "vma"))
 def spmm_pallas(tsrc, tld, tw, table, tb: int = 256, interpret: bool = False,
-                vma: tuple | None = None):
+                emulate: bool = False, vma: tuple | None = None):
     """Â·table via the tiled Pallas kernel.
 
     Args:
       tsrc/tld/tw: (T, Emax) tile arrays from ``build_dst_tiles``.
       table: (N, f) feature rows (local ‖ halo), f a multiple of 128 ideally.
-      interpret: run in interpreter mode (CPU CI).
+      interpret: run ``pl.pallas_call`` in interpreter mode (CPU CI) — the
+        kernel BODY executes, off-TPU.
+      emulate: skip pallas entirely and run an exact jnp emulation of the
+        tile semantics — used ONLY by the shard_map path off-TPU, where
+        pallas interpret mode trips a JAX vma-analysis bug in its internal
+        scan.  Standalone CI keeps ``interpret=True`` so the kernel body and
+        the vma-annotated out_shape stay covered off-TPU.
       vma: mesh axis names the output varies over — REQUIRED when called
         inside ``shard_map`` (pallas_call outputs must declare their
         varying axes under check_vma).
@@ -85,11 +91,7 @@ def spmm_pallas(tsrc, tld, tw, table, tb: int = 256, interpret: bool = False,
 
     t, emax = tsrc.shape
     f = table.shape[-1]
-    if interpret:
-        # exact jnp emulation of the tile semantics — pallas interpret mode
-        # inside shard_map trips a JAX vma-analysis bug in its internal
-        # scan, and the Mosaic path is TPU-only anyway; the standalone
-        # kernel is still interpret-tested outside shard_map
+    if emulate:
         gathered = jnp.take(table, tsrc.reshape(-1), axis=0) \
             * tw.reshape(-1)[:, None]
         flat_dst = (jnp.arange(t, dtype=jnp.int32)[:, None] * tb
@@ -144,17 +146,20 @@ def spmm_pallas(tsrc, tld, tw, table, tb: int = 256, interpret: bool = False,
 # path).  SGCN_PALLAS_VMEM overrides the byte budget.
 import os as _os
 
-_PALLAS_TABLE_BUDGET = int(_os.environ.get("SGCN_PALLAS_VMEM",
-                                           4 * 1024 * 1024))
+
+def _pallas_table_budget() -> int:
+    # read at call time so SGCN_PALLAS_VMEM set after import (monkeypatch,
+    # programmatic use) takes effect — ADVICE r4
+    return int(_os.environ.get("SGCN_PALLAS_VMEM", 4 * 1024 * 1024))
 
 
 def pallas_spmm_fits(plan, fin: int, widths) -> bool:
     """True when every layer's per-chip [local] and [halo] feature tables
     fit the kernel's VMEM budget — the k-way-sharded regime the kernel was
     kept for (plan.b ≈ n/k shrinks as k grows)."""
+    budget = _pallas_table_budget()
     fmax = max([fin, *widths])
-    return (plan.b * fmax * 4 <= _PALLAS_TABLE_BUDGET
-            and plan.r * fmax * 4 <= _PALLAS_TABLE_BUDGET)
+    return (plan.b * fmax * 4 <= budget and plan.r * fmax * 4 <= budget)
 
 
 def use_pallas_spmm(plan, fin: int, widths) -> bool:
@@ -173,40 +178,42 @@ PALLAS_PLAN_FIELDS = ("send_idx", "halo_src", "ptile_lsrc", "ptile_lld",
 
 
 def _pspmm_pallas_once(h, send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw,
-                       tb, interpret, axis_name):
+                       tb, emulate, axis_name):
     from .pspmm import halo_exchange
 
     halo = halo_exchange(h, send_idx, halo_src, axis_name)
     b = h.shape[0]
     local = spmm_pallas(lsrc, lld, lw, h.astype(jnp.float32), tb=tb,
-                        interpret=interpret, vma=(axis_name,))[:b]
+                        emulate=emulate, vma=(axis_name,))[:b]
     remote = spmm_pallas(hsrc, hld, hw, halo.astype(jnp.float32), tb=tb,
-                         interpret=interpret, vma=(axis_name,))[:b]
+                         emulate=emulate, vma=(axis_name,))[:b]
     return (local + remote).astype(h.dtype)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
 def pspmm_pallas_sym(h, send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw,
-                     tb=256, interpret=False, axis_name="v"):
+                     tb=256, emulate=False, axis_name="v"):
     """``pspmm_ell_sym`` with the VMEM-resident Pallas kernel as the local
     aggregator — same overlap structure (local pass independent of the
     exchange), same symmetric gather-only backward.  Selected by the
-    trainer via ``use_pallas_spmm`` when per-chip tables fit VMEM."""
+    trainer via ``use_pallas_spmm`` when per-chip tables fit VMEM.
+    ``emulate=True`` (the off-TPU shard_map path) swaps in the jnp
+    emulation — see ``spmm_pallas``."""
     return _pspmm_pallas_once(h, send_idx, halo_src, lsrc, lld, lw,
-                              hsrc, hld, hw, tb, interpret, axis_name)
+                              hsrc, hld, hw, tb, emulate, axis_name)
 
 
 def _pspmm_pallas_sym_fwd(h, send_idx, halo_src, lsrc, lld, lw, hsrc, hld,
-                          hw, tb, interpret, axis_name):
+                          hw, tb, emulate, axis_name):
     out = _pspmm_pallas_once(h, send_idx, halo_src, lsrc, lld, lw,
-                             hsrc, hld, hw, tb, interpret, axis_name)
+                             hsrc, hld, hw, tb, emulate, axis_name)
     return out, (send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw)
 
 
-def _pspmm_pallas_sym_bwd(tb, interpret, axis_name, res, g):
+def _pspmm_pallas_sym_bwd(tb, emulate, axis_name, res, g):
     send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw = res
     gh = _pspmm_pallas_once(g, send_idx, halo_src, lsrc, lld, lw,
-                            hsrc, hld, hw, tb, interpret, axis_name)
+                            hsrc, hld, hw, tb, emulate, axis_name)
     return (gh,) + (None,) * 8
 
 

@@ -214,7 +214,7 @@ class FullBatchTrainer:
                 self.plan_fields = PALLAS_PLAN_FIELDS
                 self._fwd_static = {
                     "pallas_tb": plan.pallas_tb,
-                    "pallas_interpret": jax.default_backend() != "tpu",
+                    "pallas_emulate": jax.default_backend() != "tpu",
                 }
         if model == "gat":
             # pre-flight the measured single-chip capacity edge: a clear
@@ -240,12 +240,15 @@ class FullBatchTrainer:
         self.last_err = None
         arrays = _plan_arrays(plan, self.plan_fields)
         if model == "gat":
-            # attention IGNORES Â's values (scores replace them; the layers
-            # only test w > 0), so the edge masks ship as int8 — the f32
-            # forms are ~0.6 GB of per-chip arguments at products scale,
-            # part of the round-4 OOM margin
+            # attention IGNORES Â's values (scores replace them), so the
+            # edge masks ship as int8 — the f32 forms are ~0.6 GB of
+            # per-chip arguments at products scale, part of the round-4 OOM
+            # margin.  Mask on w != 0: plan padding carries weight exactly 0
+            # by construction, so this keeps every real edge even for a
+            # signed/unnormalized weighted graph (ADVICE r4 — `> 0` silently
+            # dropped negative-weight edges).
             for f in ("cell_w", "ctail_w"):
-                arrays[f] = (arrays[f] > 0).astype(np.int8)
+                arrays[f] = (arrays[f] != 0).astype(np.int8)
         self.pa = shard_stacked(self.mesh, arrays)
         self.stats = CommStats.from_plan(plan)
         self._step = self._build_step()
