@@ -30,7 +30,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from ..ops.pspmm import halo_exchange
+from ..ops.pspmm import a2a_or_identity, halo_exchange
 from ..parallel.mesh import AXIS
 from .activations import get_activation
 
@@ -258,8 +258,7 @@ def _exchange_rows_scalar(p, u, send_idx, halo_src, axis_name):
     ``[local; halo]`` pair ``(full_p (B+R, fout), full_u (B+R,))``."""
     halo_p = halo_exchange(p, send_idx, halo_src, axis_name)
     buf_u = jnp.take(u, send_idx, axis=0)                    # (k, S)
-    recv_u = jax.lax.all_to_all(buf_u, axis_name, split_axis=0,
-                                concat_axis=0)
+    recv_u = a2a_or_identity(buf_u, axis_name)
     halo_u = jnp.take(recv_u.reshape(-1), halo_src, axis=0)  # (R,)
     return (jnp.concatenate([p, halo_p], axis=0),
             jnp.concatenate([u, halo_u]))

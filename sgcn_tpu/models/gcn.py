@@ -69,6 +69,10 @@ def gcn_forward_local(
     pallas_tb: int | None = None,       # static: VMEM-kernel tile height —
                                         # selects the Pallas aggregator
     pallas_emulate: bool = False,       # static: jnp emulation (off-TPU shard_map CI)
+    halo_dtype: str | None = None,      # static: wire-only exchange dtype
+                                        # ('bfloat16' halves ICI bytes;
+                                        # tables/activations stay f32 —
+                                        # ops/pspmm.py::halo_exchange)
     axis_name: str = AXIS,
 ):
     """Per-chip forward: L × (pspmm ⊗ dense matmul → activation) → (B, nout).
@@ -103,7 +107,7 @@ def gcn_forward_local(
                 x, pa["send_idx"], pa["halo_src"],
                 pa["ptile_lsrc"], pa["ptile_lld"], pa["ptile_lw"],
                 pa["ptile_hsrc"], pa["ptile_hld"], pa["ptile_hw"],
-                pallas_tb, pallas_emulate, axis_name)
+                pallas_tb, pallas_emulate, axis_name, halo_dtype)
     elif symmetric:
         if ell_buckets is None:
             raise ValueError(
@@ -114,14 +118,14 @@ def gcn_forward_local(
                 x, pa["send_idx"], pa["halo_src"], pa["ell_idx"], pa["ell_w"],
                 pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
                 pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
-                ell_buckets, axis_name)
+                ell_buckets, axis_name, halo_dtype)
     else:
         def agg(x):
             return pspmm_overlap(
                 x, pa["send_idx"], pa["halo_src"],
                 pa["ledge_dst"], pa["ledge_src"], pa["ledge_w"],
                 pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
-                axis_name=axis_name)
+                axis_name=axis_name, halo_dtype=halo_dtype)
 
     for i, w in enumerate(params):
         if w.shape[1] < h.shape[1] and h.shape[1] >= PROJECT_FIRST_MIN_FIN:

@@ -178,10 +178,10 @@ PALLAS_PLAN_FIELDS = ("send_idx", "halo_src", "ptile_lsrc", "ptile_lld",
 
 
 def _pspmm_pallas_once(h, send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw,
-                       tb, emulate, axis_name):
+                       tb, emulate, axis_name, halo_dtype=None):
     from .pspmm import halo_exchange
 
-    halo = halo_exchange(h, send_idx, halo_src, axis_name)
+    halo = halo_exchange(h, send_idx, halo_src, axis_name, halo_dtype)
     b = h.shape[0]
     local = spmm_pallas(lsrc, lld, lw, h.astype(jnp.float32), tb=tb,
                         emulate=emulate, vma=(axis_name,))[:b]
@@ -190,9 +190,9 @@ def _pspmm_pallas_once(h, send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw,
     return (local + remote).astype(h.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+@partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12))
 def pspmm_pallas_sym(h, send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw,
-                     tb=256, emulate=False, axis_name="v"):
+                     tb=256, emulate=False, axis_name="v", halo_dtype=None):
     """``pspmm_ell_sym`` with the VMEM-resident Pallas kernel as the local
     aggregator — same overlap structure (local pass independent of the
     exchange), same symmetric gather-only backward.  Selected by the
@@ -200,20 +200,23 @@ def pspmm_pallas_sym(h, send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw,
     ``emulate=True`` (the off-TPU shard_map path) swaps in the jnp
     emulation — see ``spmm_pallas``."""
     return _pspmm_pallas_once(h, send_idx, halo_src, lsrc, lld, lw,
-                              hsrc, hld, hw, tb, emulate, axis_name)
+                              hsrc, hld, hw, tb, emulate, axis_name,
+                              halo_dtype)
 
 
 def _pspmm_pallas_sym_fwd(h, send_idx, halo_src, lsrc, lld, lw, hsrc, hld,
-                          hw, tb, emulate, axis_name):
+                          hw, tb, emulate, axis_name, halo_dtype):
     out = _pspmm_pallas_once(h, send_idx, halo_src, lsrc, lld, lw,
-                             hsrc, hld, hw, tb, emulate, axis_name)
+                             hsrc, hld, hw, tb, emulate, axis_name,
+                             halo_dtype)
     return out, (send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw)
 
 
-def _pspmm_pallas_sym_bwd(tb, emulate, axis_name, res, g):
+def _pspmm_pallas_sym_bwd(tb, emulate, axis_name, halo_dtype, res, g):
     send_idx, halo_src, lsrc, lld, lw, hsrc, hld, hw = res
     gh = _pspmm_pallas_once(g, send_idx, halo_src, lsrc, lld, lw,
-                            hsrc, hld, hw, tb, emulate, axis_name)
+                            hsrc, hld, hw, tb, emulate, axis_name,
+                            halo_dtype)
     return (gh,) + (None,) * 8
 
 

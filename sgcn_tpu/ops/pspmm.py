@@ -107,7 +107,8 @@ def bucketed_slot_reduce(flat_idx, flat_w, buckets, contrib, init,
     return outs
 
 
-def halo_exchange(h, send_idx, halo_src, axis_name: str = AXIS):
+def halo_exchange(h, send_idx, halo_src, axis_name: str = AXIS,
+                  halo_dtype=None):
     """Exchange boundary rows; return this chip's halo row block.
 
     Args:
@@ -116,15 +117,43 @@ def halo_exchange(h, send_idx, halo_src, axis_name: str = AXIS):
         receivers never gather padded slots).
       halo_src: (R,) flat indices into the received (k*S, f) buffer, in the
         plan's (owner, vertex-id) halo order.
+      halo_dtype: optional narrower dtype for the WIRE only (the TPU-native
+        lever the f32-only reference lacks): the send buffer is cast after
+        the send-side gather and the halo rows are upcast back to ``h.dtype``
+        after the halo gather, so exactly the ``all_to_all`` bytes halve
+        (``'bfloat16'``) while every table, activation and accumulation
+        stays f32.  Single-chip bf16 compute measured SLOWER (BASELINE.md:
+        gathers are row-rate-bound and master-array casts are pure
+        overhead); the wire is the one place narrow pays, because ICI
+        bytes are the multi-chip bottleneck the partitioner minimizes.
 
     Returns:
       (R, f) halo rows (padding rows contain garbage; they are only referenced
       by weight-0 edges).
     """
     buf = jnp.take(h, send_idx, axis=0)                     # (k, S, f)
-    recv = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0)
+    if halo_dtype is not None:
+        buf = buf.astype(halo_dtype)
+    recv = a2a_or_identity(buf, axis_name)
     flat = recv.reshape(-1, h.shape[-1])                    # (k*S, f)
-    return jnp.take(flat, halo_src, axis=0)                 # (R, f)
+    return jnp.take(flat, halo_src, axis=0).astype(h.dtype)  # (R, f)
+
+
+def a2a_or_identity(buf, axis_name: str):
+    """``lax.all_to_all`` of a per-peer-bucketed buffer, degrading to an
+    identity on a size-1 mesh axis (jax's all_to_all rejects
+    split_dim != axis_size).  The identity is pinned with an
+    ``optimization_barrier``: XLA would otherwise fuse the send-side gather
+    into the halo gather — fine for a true k=1 plan (empty halo), but the
+    shard-proxy measurement (``sgcn_tpu.parallel.proxy``) runs a k>1 chip's
+    program on one device and needs the send-buffer materialization to
+    stay, exactly as on a real k-chip mesh.  Shared by every exchange
+    (feature rows here, the GAT scalar buffer in ``models/gat.py``) so
+    proxy fidelity has one home."""
+    if lax.axis_size(axis_name) == 1:
+        (recv,) = lax.optimization_barrier((buf,))
+        return recv
+    return lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0)
 
 
 def spmm_local(edge_dst, edge_src, edge_w, table, num_rows: int):
@@ -163,7 +192,7 @@ def pspmm_exchange(h, send_idx, halo_src, edge_dst, edge_src, edge_w,
 def pspmm_overlap(h, send_idx, halo_src,
                   ledge_dst, ledge_src, ledge_w,
                   hedge_dst, hedge_src, hedge_w,
-                  axis_name: str = AXIS):
+                  axis_name: str = AXIS, halo_dtype=None):
     """``PSpMM`` with the reference's comm/compute-overlap structure.
 
     The edge list is split at plan time by source locality
@@ -178,7 +207,7 @@ def pspmm_overlap(h, send_idx, halo_src,
     Under JAX transposition the backward keeps the same split: the gradient
     all_to_all overlaps with the local-src transpose-SpMM.
     """
-    halo = halo_exchange(h, send_idx, halo_src, axis_name)
+    halo = halo_exchange(h, send_idx, halo_src, axis_name, halo_dtype)
     # no data dependence on `halo` — XLA overlaps this with the exchange
     local = spmm_local(ledge_dst, ledge_src, ledge_w, h, h.shape[0])
     remote = spmm_local(hedge_dst, hedge_src, hedge_w, halo, h.shape[0])
@@ -231,19 +260,20 @@ def spmm_ell(ell_idx, ell_w, tail_dst, tail_src, tail_w, h, buckets):
 
 def _pspmm_ell_once(h, send_idx, halo_src, ell_idx, ell_w,
                     ltail_dst, ltail_src, ltail_w,
-                    hedge_dst, hedge_src, hedge_w, buckets, axis_name):
-    halo = halo_exchange(h, send_idx, halo_src, axis_name)
+                    hedge_dst, hedge_src, hedge_w, buckets, axis_name,
+                    halo_dtype=None):
+    halo = halo_exchange(h, send_idx, halo_src, axis_name, halo_dtype)
     # local ELL aggregation has no data dependence on the exchange (overlap)
     local = spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, h, buckets)
     remote = spmm_local(hedge_dst, hedge_src, hedge_w, halo, h.shape[0])
     return local + remote
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(11, 12))
+@partial(jax.custom_vjp, nondiff_argnums=(11, 12, 13))
 def pspmm_ell_sym(h, send_idx, halo_src, ell_idx, ell_w,
                   ltail_dst, ltail_src, ltail_w,
                   hedge_dst, hedge_src, hedge_w, buckets,
-                  axis_name=AXIS):
+                  axis_name=AXIS, halo_dtype=None):
     """``PSpMM`` for a SYMMETRIC Â: ELL local aggregation + overlap structure,
     with a custom backward that reuses the forward form.
 
@@ -261,26 +291,32 @@ def pspmm_ell_sym(h, send_idx, halo_src, ell_idx, ell_w,
     """
     return _pspmm_ell_once(h, send_idx, halo_src, ell_idx, ell_w,
                            ltail_dst, ltail_src, ltail_w,
-                           hedge_dst, hedge_src, hedge_w, buckets, axis_name)
+                           hedge_dst, hedge_src, hedge_w, buckets, axis_name,
+                           halo_dtype)
 
 
 def _pspmm_ell_sym_fwd(h, send_idx, halo_src, ell_idx, ell_w,
                        ltail_dst, ltail_src, ltail_w,
-                       hedge_dst, hedge_src, hedge_w, buckets, axis_name):
+                       hedge_dst, hedge_src, hedge_w, buckets, axis_name,
+                       halo_dtype):
     out = _pspmm_ell_once(h, send_idx, halo_src, ell_idx, ell_w,
                           ltail_dst, ltail_src, ltail_w,
-                          hedge_dst, hedge_src, hedge_w, buckets, axis_name)
+                          hedge_dst, hedge_src, hedge_w, buckets, axis_name,
+                          halo_dtype)
     res = (send_idx, halo_src, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
            hedge_dst, hedge_src, hedge_w)
     return out, res
 
 
-def _pspmm_ell_sym_bwd(buckets, axis_name, res, g):
+def _pspmm_ell_sym_bwd(buckets, axis_name, halo_dtype, res, g):
     (send_idx, halo_src, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
      hedge_dst, hedge_src, hedge_w) = res
+    # the gradient exchange rides the same narrow wire as the forward's —
+    # both directions of ICI traffic halve under halo_dtype='bfloat16'
     gh = _pspmm_ell_once(g, send_idx, halo_src, ell_idx, ell_w,
                          ltail_dst, ltail_src, ltail_w,
-                         hedge_dst, hedge_src, hedge_w, buckets, axis_name)
+                         hedge_dst, hedge_src, hedge_w, buckets, axis_name,
+                         halo_dtype)
     zeros = [None] * 10
     return (gh, *zeros)
 

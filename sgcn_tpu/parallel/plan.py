@@ -151,6 +151,12 @@ class CommPlan:
     ptile_hld: np.ndarray | None = None   # (k, T, EmaxH) int32
     ptile_hw: np.ndarray | None = None    # (k, T, EmaxH) float32
 
+    # identities of the chips this (possibly sliced) plan's rows describe —
+    # set by the shard proxy (``parallel/proxy.py``) so the comm-stat
+    # properties zero each row's TRUE self-slot rather than assuming row i
+    # talks to itself at column i.  None = the full square plan.
+    chip_ids: np.ndarray | None = None
+
     def ensure_pallas_tiles(self, tb: int = 256) -> "CommPlan":
         """Build the Pallas dst-tile layout on first use.
 
@@ -211,6 +217,18 @@ class CommPlan:
         return self
 
     # ------------------------------------------------------------------ stats
+    def offwire_send_counts(self) -> np.ndarray:
+        """``send_counts`` with each row's SELF-slot zeroed — the rows that
+        actually cross the wire.  On the full square plan row i's self-slot
+        is column i; a shard-proxy slice records the true chip identity in
+        ``chip_ids`` (row 0 of chip c's proxy self-sends at column c)."""
+        off = self.send_counts.astype(np.int64).copy()
+        if self.chip_ids is not None:
+            off[np.arange(off.shape[0]), np.asarray(self.chip_ids)] = 0
+        else:
+            np.fill_diagonal(off, 0)
+        return off
+
     @property
     def predicted_send_volume(self) -> np.ndarray:
         """Per-chip boundary rows shipped per exchange (k,).
@@ -220,16 +238,12 @@ class CommPlan:
         partitioners' connectivity metric Σ(λ−1)
         (``GCN-HP/main.cpp:335-345``).
         """
-        off = self.send_counts.copy()
-        np.fill_diagonal(off, 0)
-        return off.sum(axis=1)
+        return self.offwire_send_counts().sum(axis=1)
 
     @property
     def predicted_message_count(self) -> np.ndarray:
         """Per-chip count of non-empty peer messages (k,)."""
-        off = self.send_counts.copy()
-        np.fill_diagonal(off, 0)
-        return (off > 0).sum(axis=1)
+        return (self.offwire_send_counts() > 0).sum(axis=1)
 
     # --------------------------------------------------------- data placement
     def scatter_rows(self, x: np.ndarray, fill: float = 0.0,

@@ -53,6 +53,9 @@ def main() -> None:
                         "(Parallel-GCN/main.c:70-90,318-335)")
     p.add_argument("--dtype", default=None, choices=["bfloat16"],
                    help="mixed-precision compute (f32 master params)")
+    p.add_argument("--halo-dtype", default=None, choices=["bfloat16"],
+                   help="wire-only exchange dtype: halves a2a ICI bytes, "
+                        "all compute stays f32 (full-batch GCN only)")
     p.add_argument("--epochs", type=int, default=4)
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--lr", type=float, default=0.01)
@@ -88,6 +91,18 @@ def main() -> None:
                         "those)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
+
+    # pure flag conflicts fail BEFORE any dataset load (minutes at scale)
+    if args.halo_dtype and (args.batch_size is not None
+                            or args.model != "gcn"
+                            or args.experiment == "accuracy"
+                            or args.dtype):
+        raise SystemExit(
+            "--halo-dtype narrows the full-batch GCN exchange only (the "
+            "mini-batch trainer and GAT narrow via --dtype bfloat16; the "
+            "accuracy-parity harness is defined for the f32-wire config; "
+            "under --dtype bfloat16 the wire is already bf16, so the flag "
+            "would be a silent no-op)")
 
     from ..utils.backend import enable_tpu_async_collectives, use_cpu_devices
     if args.backend == "cpu":
@@ -205,7 +220,8 @@ def main() -> None:
             tr = FullBatchTrainer(plan, fin=f, widths=widths, lr=args.lr,
                                   model=args.model, loss=args.loss,
                                   activation=activation, seed=args.seed,
-                                  compute_dtype=args.dtype)
+                                  compute_dtype=args.dtype,
+                                  halo_dtype=args.halo_dtype)
             state = tr
             start_step = 0
             if args.resume:

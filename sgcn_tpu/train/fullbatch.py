@@ -184,17 +184,34 @@ class FullBatchTrainer:
         loss: str = "xent",
         compute_dtype: str | None = None,
         remat: bool = False,
+        halo_dtype: str | None = None,
     ):
         """``compute_dtype='bfloat16'`` runs forward/backward (including the
         halo exchange — half the ICI bytes) in bf16 with f32 master params
         and f32 loss/grad reduction; the reference stacks are f32-only, this
         is the TPU-native mixed-precision option (MXU eats bf16).
 
+        ``halo_dtype='bfloat16'`` narrows ONLY the wire: the a2a send buffer
+        is cast after the send-side gather and upcast after the halo gather
+        (both directions — the symmetric backward's gradient exchange too),
+        so ICI bytes halve while every table, activation and accumulation
+        stays f32.  The single-chip bf16 lesson (BASELINE.md: casts of the
+        master arrays cost more than the halved HBM bytes buy) does not
+        apply: only the (k, S, f) boundary buffer is cast.  GCN only — the
+        GAT exchange ships its attention tables, which narrow via
+        ``compute_dtype='bfloat16'`` (the packed one-gather path).
+
         ``remat=True`` wraps the forward in ``jax.checkpoint`` so layer
         activations are recomputed in the backward pass instead of stored —
         the HBM-for-FLOPs trade for deep stacks / huge vertex counts (no
         reference analogue; the MPI code stores every layer's H and Z,
         ``Parallel-GCN/main.c:553-607``)."""
+        if halo_dtype is not None and model != "gcn":
+            raise ValueError(
+                "halo_dtype is a GCN-trainer lever; for GAT use "
+                "compute_dtype='bfloat16' (the packed exchange already "
+                "ships half-width rows)")
+        self.halo_dtype = halo_dtype
         self.plan = plan
         self.mesh = mesh if mesh is not None else make_mesh_1d(plan.k)
         self.activation = activation
@@ -264,12 +281,15 @@ class FullBatchTrainer:
             h0 = h0.astype(dt)
             pa = {k: v.astype(dt) if v.dtype == jnp.float32 else v
                   for k, v in pa.items()}
+        extra = ({"halo_dtype": self.halo_dtype}
+                 if self.halo_dtype is not None else {})
         out = self._forward_fn(
             params, h0, pa,
             activation=self.activation,
             final_activation=self.final_activation,
             symmetric=self.plan.symmetric,
             **self._fwd_static,
+            **extra,
         )
         return out.astype("float32")
 

@@ -29,14 +29,23 @@ class CommStats:
 
     @classmethod
     def from_plan(cls, plan) -> "CommStats":
-        off = plan.send_counts.astype(np.int64).copy()
-        np.fill_diagonal(off, 0)
+        off = plan.offwire_send_counts()
+        send_vol = plan.predicted_send_volume.astype(np.int64)
+        send_msg = plan.predicted_message_count.astype(np.int64)
+        if off.shape[0] == off.shape[1]:
+            recv_vol, recv_msg = off.sum(axis=0), (off > 0).sum(axis=0)
+        else:
+            # shard-proxy slice (rows != k): peers' sends are not in view.
+            # Every proxied pattern is symmetric (plan.symmetric), where
+            # per-chip recv == send, so reuse the send side rather than
+            # emit mis-shaped or fabricated recv counters.
+            recv_vol, recv_msg = send_vol, send_msg
         return cls(
             k=plan.k,
-            send_volume_per_exchange=plan.predicted_send_volume.astype(np.int64),
-            send_msgs_per_exchange=plan.predicted_message_count.astype(np.int64),
-            recv_volume_per_exchange=off.sum(axis=0),
-            recv_msgs_per_exchange=(off > 0).sum(axis=0),
+            send_volume_per_exchange=send_vol,
+            send_msgs_per_exchange=send_msg,
+            recv_volume_per_exchange=recv_vol,
+            recv_msgs_per_exchange=recv_msg,
         )
 
     def count_step(self, nlayers: int) -> None:
