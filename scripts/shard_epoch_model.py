@@ -237,7 +237,8 @@ def main() -> None:
         print(f"{model}: {json.dumps(out[model])}", flush=True)
         del tr
 
-    path = os.path.join(ART, f"shard_epoch_model{suffix}.json")
+    dt = "" if args.halo_dtype == "float32" else "_bf16wire"
+    path = os.path.join(ART, f"shard_epoch_model{suffix}{dt}.json")
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(out, fh, indent=1)
