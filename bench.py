@@ -158,7 +158,19 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
             return float(losses[-1])              # scalar readback = sync
         return run
 
-    return diff_time(make_run, 1, max(3, epochs)), part_metrics
+    epoch_s = diff_time(make_run, 1, max(3, epochs))
+    if model == "gcn" and plan.symmetric:
+        # roofline self-description (VERDICT r4 item 7): achieved gathered
+        # GB/s vs the measured stream ceiling.  Plan fields are per-chip
+        # padded sizes, so this is per-chip traffic (= global when k=1);
+        # bf16 compute gathers 2-byte lanes
+        gb = gather_bytes_per_epoch(plan, feats.shape[1], widths,
+                                    itemsize=2 if dtype == "bfloat16" else 4)
+        part_metrics["gather_GB_per_epoch_per_chip"] = round(gb / 1e9, 3)
+        part_metrics["achieved_gather_GBs"] = round(gb / epoch_s / 1e9, 1)
+        part_metrics["stream_ceiling_frac"] = round(
+            gb / epoch_s / 1e9 / STREAM_CEILING_GBS, 3)
+    return epoch_s, part_metrics
 
 
 def bench_minibatch(ahat, feats, labels, widths, batch_size: int,
@@ -199,6 +211,33 @@ def bench_minibatch(ahat, feats, labels, widths, batch_size: int,
             sum(int(p.predicted_send_volume.sum()) for p in tr.plans)
             * 2 * len(widths),
     }
+
+
+# Measured achievable HBM stream rate through XLA on this chip (BASELINE.md
+# microbenchmarks: 655 GB/s = 80% of nominal); the denominator of the
+# gather-utilization figure — the MFU-analogue for this gather-bound workload.
+STREAM_CEILING_GBS = 655.0
+
+
+def gather_bytes_per_epoch(plan, fin: int, widths,
+                           itemsize: int = 4) -> int:
+    """Bytes the epoch's row gathers move (fwd + symmetric bwd), from the
+    plan's padded layout — the numerator of the roofline figure.
+
+    Counts the gather streams only (ELL slots, hub tails, halo-src edges,
+    send-buffer and halo-buffer gathers), at the aggregation width of each
+    layer (``models/gcn.py::exchange_widths`` — the trainer's project-first
+    rule).  Accumulate-side traffic (~30% more, BASELINE.md utilization
+    accounting) is deliberately excluded: the metric is 'how fast are the
+    gathers running', matching the measured 655 GB/s stream ceiling
+    denominator.
+    """
+    from sgcn_tpu.models.gcn import exchange_widths
+    ell_slots = sum(nb * wb for nb, wb in plan.ell_buckets)
+    rows = ell_slots + plan.tl          # local ELL + tail
+    rows += plan.eh                     # halo-src edge gathers
+    rows += plan.k * plan.s + plan.r    # send-buffer + halo-table gathers
+    return int(2 * rows * itemsize * sum(exchange_widths(fin, widths)))
 
 
 def bench_dense_equiv(n: int, fin: int, widths, epochs: int) -> float:
@@ -343,6 +382,63 @@ def bench_vdev_partitioned(n: int, avg_deg: int, f: int, widths, epochs: int,
         return {"epoch_s_8dev_cpu": None}
 
 
+def bench_ab_baseline(args, rev: str) -> dict:
+    """Same-session code A/B for the GB-table regime (VERDICT r4 item 9).
+
+    Products-scale absolute rates drift with chip/tunnel state across
+    sessions (BASELINE.md: identical code measured 2.18 s one session and
+    3.63 s another, while a same-session worktree A/B of the two code
+    versions gave 3.631 vs 3.630 s).  So when benching at table sizes in
+    the drift regime, the previous round's pinned code runs in THIS session
+    too: check `rev` out into a temp worktree, run the same flagship config
+    there (yardsticks and diagnostics skipped), and emit its number as
+    ``same_session_baseline_s``.  Comparable numbers or the pair is wrong —
+    the cross-session delta is then attributable to code, not chip state.
+    """
+    import shutil
+    import tempfile
+
+    wt = tempfile.mkdtemp(prefix="sgcn_ab_")
+    try:
+        subprocess.run(["git", "worktree", "add", "--detach", wt, rev],
+                       capture_output=True, text=True, check=True,
+                       cwd=os.path.dirname(os.path.abspath(__file__)))
+        cmd = [sys.executable, os.path.join(wt, "bench.py"),
+               "-n", str(args.n), "--avg-deg", str(args.avg_deg),
+               "-f", str(args.f), "--hidden", str(args.hidden),
+               "--classes", str(args.classes), "-l", str(args.layers),
+               "-e", str(args.epochs), "--graph", args.graph,
+               "--model", args.model, "--skip-torch", "--skip-vdev"]
+        # the numeric config must match or the A/B attributes dtype/remat
+        # effects to code; and the child must not recurse into its own
+        # pinned baseline (rev chains once the pin file is committed)
+        if args.dtype:
+            cmd += ["--dtype", args.dtype]
+        if args.remat:
+            cmd += ["--remat"]
+        probe = subprocess.run(
+            [sys.executable, os.path.join(wt, "bench.py"), "--help"],
+            capture_output=True, text=True, cwd=wt)
+        if "--ab-baseline" in probe.stdout:
+            cmd += ["--ab-baseline", "none"]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=3600, cwd=wt)
+        if proc.returncode != 0:
+            raise RuntimeError(f"rc={proc.returncode}: {proc.stderr[-300:]}")
+        child = json.loads(proc.stdout.strip().splitlines()[-1])
+        return {"same_session_baseline_s": child["value"],
+                "same_session_baseline_rev": rev}
+    except Exception as e:                      # noqa: BLE001 — diagnostic path
+        print(f"# same-session baseline run failed: {e!r}", file=sys.stderr)
+        return {"same_session_baseline_s": None,
+                "same_session_baseline_rev": rev}
+    finally:
+        subprocess.run(["git", "worktree", "remove", "--force", wt],
+                       capture_output=True,
+                       cwd=os.path.dirname(os.path.abspath(__file__)))
+        shutil.rmtree(wt, ignore_errors=True)
+
+
 def products_partition_block() -> dict:
     """Products-scale partitioner evidence (VERDICT r3 item 1): the native
     hypergraph/graph partitioners run OFFLINE on the exact products-shape
@@ -419,6 +515,11 @@ def main() -> None:
                    help="synthetic graph family: er (no hubs) or ba "
                         "(power-law tail, the ogbn-like profile)")
     p.add_argument("--skip-torch", action="store_true")
+    p.add_argument("--ab-baseline", default=None, metavar="REV",
+                   help="git rev to run the SAME config from in this "
+                        "session (same_session_baseline_s).  Default: for "
+                        "GB-table runs (-n >= 1M) the rev pinned in "
+                        "bench_artifacts/ab_baseline_rev; 'none' disables")
     p.add_argument("--skip-vdev", action="store_true",
                    help="skip the virtual-8-device partitioned diagnostic run")
     p.add_argument("--vdev-n", type=int, default=120_000,
@@ -492,6 +593,15 @@ def main() -> None:
     extra = {}
     if not args.vdev_child:
         extra.update(products_partition_block())
+    ab_rev = args.ab_baseline
+    if ab_rev is None and args.n >= 1_000_000:
+        pin = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_artifacts", "ab_baseline_rev")
+        if os.path.exists(pin):
+            with open(pin) as fh:
+                ab_rev = fh.read().strip()
+    if ab_rev and ab_rev != "none" and not args.vdev_child:
+        extra.update(bench_ab_baseline(args, ab_rev))
     if single and args.n >= 1_000_000:
         # the measured large-table cliff (BASELINE.md micro table): this
         # single-chip number sits at the DEGRADED gather rate; per-chip

@@ -432,6 +432,7 @@ struct Hypergraph {
   std::vector<i64> netptr;    // net -> pins(cells)
   std::vector<i32> netpins;
   std::vector<i64> cwgt;      // cell weights
+  std::vector<i64> nwgt;      // net weights (identical nets merge, r5)
   i64 total_cwgt = 0;
 };
 
@@ -444,6 +445,7 @@ Hypergraph from_cells(i32 ncells, i32 nnets, const i64* cellptr,
   h.cwgt.assign(ncells, 1);
   if (cwgt) h.cwgt.assign(cwgt, cwgt + ncells);
   h.total_cwgt = std::accumulate(h.cwgt.begin(), h.cwgt.end(), (i64)0);
+  h.nwgt.assign(nnets, 1);
   // invert to net -> pins
   h.netptr.assign(nnets + 1, 0);
   for (i64 e = 0; e < (i64)h.cellnets.size(); ++e) h.netptr[h.cellnets[e] + 1]++;
@@ -456,6 +458,81 @@ Hypergraph from_cells(i32 ncells, i32 nnets, const i64* cellptr,
   return h;
 }
 
+// Rebuild cell -> nets from net -> pins.  Scanning nets ascending makes each
+// cell's list sorted and duplicate-free (each net contributes one entry).
+void rebuild_cellnets(Hypergraph& h) {
+  h.cellptr.assign(h.ncells + 1, 0);
+  for (i32 c : h.netpins) h.cellptr[c + 1]++;
+  for (i32 c = 0; c < h.ncells; ++c) h.cellptr[c + 1] += h.cellptr[c];
+  h.cellnets.assign(h.netpins.size(), 0);
+  std::vector<i64> pos(h.cellptr.begin(), h.cellptr.end() - 1);
+  for (i32 j = 0; j < h.nnets; ++j)
+    for (i64 p = h.netptr[j]; p < h.netptr[j + 1]; ++p)
+      h.cellnets[pos[h.netpins[p]]++] = j;
+}
+
+// Net compaction (the PaToH family's identical-net trick, r5 speed pass):
+//   * single-pin nets can never be cut (λ ≤ 1 ⇒ km1 contribution 0) — drop;
+//   * nets with the SAME pin set contribute identically to km1/gains — merge
+//     into one net carrying the summed weight.
+// Exact for the weighted km1 objective every consumer below now uses.  The
+// payoff compounds through the V-cycle: without it every coarse level drags
+// the full fine-level net count through pincounts/km1/greedy scans (measured
+// 55-80% of partitioner wall-clock at 0.6-2.45M cells before this change).
+void compact_nets(Hypergraph& h) {
+  const i32 nn = h.nnets;
+  if (h.nwgt.empty()) h.nwgt.assign(nn, 1);
+  // hash each net's pin sequence (pins are sorted: netpins is built by
+  // scanning cells/nets ascending everywhere in this file)
+  std::vector<uint64_t> hash(nn);
+  for (i32 j = 0; j < nn; ++j) {
+    uint64_t hv = 1469598103934665603ull;
+    for (i64 p = h.netptr[j]; p < h.netptr[j + 1]; ++p) {
+      hv ^= (uint64_t)(uint32_t)h.netpins[p];
+      hv *= 1099511628211ull;
+    }
+    hash[j] = hv;
+  }
+  std::unordered_map<uint64_t, std::vector<i32>> groups;
+  groups.reserve(nn);
+  std::vector<i32> remap(nn, -1);      // old net -> new net (-1 = dropped)
+  std::vector<i64> new_nwgt;
+  std::vector<i64> new_netptr{0};
+  std::vector<i32> new_netpins;
+  new_nwgt.reserve(nn);
+  i32 nj = 0;
+  auto same_pins = [&](i32 a, i32 b) {
+    i64 la = h.netptr[a + 1] - h.netptr[a];
+    if (la != h.netptr[b + 1] - h.netptr[b]) return false;
+    return std::equal(h.netpins.begin() + h.netptr[a],
+                      h.netpins.begin() + h.netptr[a + 1],
+                      h.netpins.begin() + h.netptr[b]);
+  };
+  for (i32 j = 0; j < nn; ++j) {
+    if (h.netptr[j + 1] - h.netptr[j] < 2) continue;   // single-pin: drop
+    auto& bucket = groups[hash[j]];
+    i32 found = -1;
+    for (i32 rep : bucket)
+      if (same_pins(rep, j)) { found = remap[rep]; break; }
+    if (found >= 0) {
+      new_nwgt[found] += h.nwgt[j];
+      remap[j] = found;
+      continue;
+    }
+    bucket.push_back(j);
+    remap[j] = nj++;
+    new_nwgt.push_back(h.nwgt[j]);
+    new_netpins.insert(new_netpins.end(), h.netpins.begin() + h.netptr[j],
+                       h.netpins.begin() + h.netptr[j + 1]);
+    new_netptr.push_back((i64)new_netpins.size());
+  }
+  h.nnets = nj;
+  h.nwgt = std::move(new_nwgt);
+  h.netptr = std::move(new_netptr);
+  h.netpins = std::move(new_netpins);
+  rebuild_cellnets(h);
+}
+
 // heavy-connectivity matching: match cells sharing the most nets
 MatchResult hc_matching(const Hypergraph& h, Rng& rng,
                         i64 big_net_threshold) {
@@ -466,21 +543,34 @@ MatchResult hc_matching(const Hypergraph& h, Rng& rng,
   // flat scratch + touched-list instead of a hash map: this loop is the
   // single-core hot path at products scale (2.45M cells × ~2.5k candidate
   // scans), and the array form measured several× faster than unordered_map
-  std::vector<i32> shared(h.ncells, 0);
+  std::vector<i64> shared(h.ncells, 0);
   std::vector<i32> touched;
   touched.reserve(4096);
+  // Per-cell candidate-scan budget (r5 speed pass): matching needs a
+  // heavy-ish partner, not THE heaviest — capping pin touches bounds the
+  // deg² term that dominated coarsening wall-clock at products scale.
+  // Nets arrive in arbitrary (graph-construction) order, so the truncated
+  // scan is an unbiased sample of v's nets.
+  const i64 scan_budget = 2048;
   for (i32 v : order) {
     if (match[v] != -1) continue;
-    for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
+    i64 budget = scan_budget;
+    for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1] && budget > 0; ++e) {
       i32 net = h.cellnets[e];
       i64 deg = h.netptr[net + 1] - h.netptr[net];
       if (deg > big_net_threshold) continue;        // skip huge nets (cost)
+      budget -= deg;
+      const i64 w = h.nwgt.empty() ? 1 : h.nwgt[net];
       for (i64 p = h.netptr[net]; p < h.netptr[net + 1]; ++p) {
         i32 u = h.netpins[p];
-        if (u != v && match[u] == -1 && shared[u]++ == 0) touched.push_back(u);
+        if (u != v && match[u] == -1) {
+          if (shared[u] == 0) touched.push_back(u);
+          shared[u] += w;
+        }
       }
     }
-    i32 best = -1, best_s = 0;
+    i32 best = -1;
+    i64 best_s = 0;
     for (i32 u : touched) {
       if (shared[u] > best_s) { best_s = shared[u]; best = u; }
       shared[u] = 0;
@@ -524,7 +614,10 @@ Hypergraph contract_h(const Hypergraph& h, const MatchResult& m) {
   c.cellnets.reserve(c.cellptr[m.cn]);
   for (i32 cv = 0; cv < m.cn; ++cv)
     c.cellnets.insert(c.cellnets.end(), nets[cv].begin(), nets[cv].end());
-  // rebuild net -> pins (drop single-pin nets? keep, harmless)
+  c.nwgt = h.nwgt.empty() ? std::vector<i64>(h.nnets, 1) : h.nwgt;
+  // rebuild net -> pins, then compact: dropping now-single-pin nets and
+  // merging now-identical ones is what keeps coarse levels from dragging
+  // the fine level's full net count through every pincount/gain scan
   c.netptr.assign(c.nnets + 1, 0);
   for (i32 x : c.cellnets) c.netptr[x + 1]++;
   for (i32 j = 0; j < c.nnets; ++j) c.netptr[j + 1] += c.netptr[j];
@@ -533,6 +626,7 @@ Hypergraph contract_h(const Hypergraph& h, const MatchResult& m) {
   for (i32 cv = 0; cv < m.cn; ++cv)
     for (i64 e = c.cellptr[cv]; e < c.cellptr[cv + 1]; ++e)
       c.netpins[pos[c.cellnets[e]]++] = cv;
+  compact_nets(c);
   return c;
 }
 
@@ -549,7 +643,8 @@ i64 km1_total(const Hypergraph& h, PinCounts& pc) {
     i32* r = pc.row(j);
     int lambda = 0;
     for (int p = 0; p < pc.k; ++p) lambda += r[p] > 0;
-    if (lambda > 1) s += lambda - 1;
+    if (lambda > 1)
+      s += (h.nwgt.empty() ? 1 : h.nwgt[j]) * (i64)(lambda - 1);
   }
   return s;
 }
@@ -588,8 +683,10 @@ void greedy_grow_h(const Hypergraph& h, int k, double cap,
     i32 v = order[idx];
     std::fill(affinity.begin(), affinity.end(), 0);
     for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
-      const i32* r = netpart.data() + (i64)h.cellnets[e] * k;
-      for (int p = 0; p < k; ++p) affinity[p] += r[p] > 0;
+      const i32 net = h.cellnets[e];
+      const i64 w = h.nwgt.empty() ? 1 : h.nwgt[net];
+      const i32* r = netpart.data() + (i64)net * k;
+      for (int p = 0; p < k; ++p) affinity[p] += (r[p] > 0) * w;
     }
     int best = -1; i64 best_a = -1;
     if (prefer_target)
@@ -618,7 +715,8 @@ struct Km1Refiner {
   std::vector<i32>& part;
   PinCounts pc;
   std::vector<i64> pw;
-  std::vector<i64> cnt;     // scratch: nets of v already present in part p
+  std::vector<i64> cnt;     // scratch: net weight of v present in part p
+  std::vector<char> cut;    // per net: pins in >= 2 parts (λ >= 2)
 
   Km1Refiner(const Hypergraph& h_, int k_, double cap_, std::vector<i32>& part_)
       : h(h_), k(k_), cap(cap_), part(part_), cnt(k_) {
@@ -626,47 +724,68 @@ struct Km1Refiner {
     build_pincounts(h, part, pc);
     pw.assign(k, 0);
     for (i32 v = 0; v < h.ncells; ++v) pw[part[v]] += h.cwgt[v];
+    cut.assign(h.nnets, 0);
+    for (i32 j = 0; j < h.nnets; ++j) {
+      const i32* r = pc.row(j);
+      int lambda = 0;
+      for (int p = 0; p < k && lambda < 2; ++p) lambda += r[p] > 0;
+      cut[j] = lambda >= 2;
+    }
   }
 
-  // Best feasible move for v.  km1 gain of moving v from pv to p:
-  //   + every net where v is pv's last pin (leaving removes pv from the net)
-  //   - every net where p has no pin yet (arriving adds p to the net)
-  //   = leave_bonus - (deg(v) - #nets of v where p already present).
+  i64 netw(i32 j) const { return h.nwgt.empty() ? 1 : h.nwgt[j]; }
+
+  // Best feasible move for v.  Weighted km1 gain of moving v from pv to p:
+  //   + weight of every net where v is pv's last pin (leaving removes pv)
+  //   - weight of every net where p has no pin yet (arriving adds p)
+  //   = leave_bonus - (degw(v) - weight of v's nets where p already present).
   // Ties prefer the lighter target part.  target = -1 when v is interior or
-  // no part has room.
+  // no part has room.  Interior test first: a cell none of whose nets are
+  // cut sees every pin in pv — deg work instead of deg·k (the r5 sweep
+  // early-out; at products scale most cells are interior once the
+  // partition settles, and the full-gain fall-through is exactly the old
+  // code, so results are unchanged).
   i64 best_move(i32 v, i32& target) {
     const int pv = part[v];
+    bool anycut = false;
+    for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e)
+      if (cut[h.cellnets[e]]) { anycut = true; break; }
+    if (!anycut) { target = -1; return 0; }
     std::fill(cnt.begin(), cnt.end(), 0);
-    i64 leave_bonus = 0;
+    i64 leave_bonus = 0, degw = 0;
     for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
-      const i32* r = pc.row(h.cellnets[e]);
-      if (r[pv] == 1) leave_bonus++;
+      const i32 net = h.cellnets[e];
+      const i64 w = netw(net);
+      degw += w;
+      const i32* r = pc.row(net);
+      if (r[pv] == 1) leave_bonus += w;
       for (int p = 0; p < k; ++p)
-        if (p != pv && r[p] > 0) cnt[p]++;
+        if (p != pv && r[p] > 0) cnt[p] += w;
     }
-    const i64 deg = h.cellptr[v + 1] - h.cellptr[v];
     target = -1;
     i64 best_gain = 0;
-    bool boundary = false;
     for (int p = 0; p < k; ++p) {
       if (p == pv) continue;
-      if (cnt[p] > 0) boundary = true;
       if (pw[p] + h.cwgt[v] > (i64)cap) continue;
-      i64 gn = leave_bonus - (deg - cnt[p]);
+      i64 gn = leave_bonus - (degw - cnt[p]);
       if (target == -1 || gn > best_gain ||
           (gn == best_gain && pw[p] < pw[target])) {
         best_gain = gn; target = p;
       }
     }
-    if (!boundary) target = -1;
     return target == -1 ? 0 : best_gain;
   }
 
   void apply(i32 v, i32 to) {
     const int pv = part[v];
     for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
-      i32* r = pc.row(h.cellnets[e]);
+      const i32 net = h.cellnets[e];
+      i32* r = pc.row(net);
       r[pv]--; r[to]++;
+      // λ can only change through the touched parts; recount lazily
+      int lambda = 0;
+      for (int p = 0; p < k && lambda < 2; ++p) lambda += r[p] > 0;
+      cut[net] = lambda >= 2;
     }
     pw[pv] -= h.cwgt[v]; pw[to] += h.cwgt[v];
     part[v] = to;
@@ -713,7 +832,7 @@ void rebalance_km1(const Hypergraph& h, int k, double cap,
   build_pincounts(h, part, pc);
   std::vector<i64> pw(k, 0);
   for (i32 v = 0; v < h.ncells; ++v) pw[part[v]] += h.cwgt[v];
-  std::vector<i32> gain(k);
+  std::vector<i64> gain(k);
   for (int pass = 0; pass < 30; ++pass) {
     bool over = false;
     for (int p = 0; p < k; ++p) over |= pw[p] > (i64)cap;
@@ -723,18 +842,20 @@ void rebalance_km1(const Hypergraph& h, int k, double cap,
       int pv = part[v];
       if (pw[pv] <= (i64)cap) continue;
       std::fill(gain.begin(), gain.end(), 0);
-      int leave_bonus = 0;
+      i64 leave_bonus = 0, degw = 0;
       for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1]; ++e) {
-        i32* r = pc.row(h.cellnets[e]);
-        if (r[pv] == 1) leave_bonus++;
+        const i32 net = h.cellnets[e];
+        const i64 w = h.nwgt.empty() ? 1 : h.nwgt[net];
+        degw += w;
+        i32* r = pc.row(net);
+        if (r[pv] == 1) leave_bonus += w;
         for (int p = 0; p < k; ++p)
-          if (p != pv && r[p] > 0) gain[p]++;
+          if (p != pv && r[p] > 0) gain[p] += w;
       }
-      i64 deg = h.cellptr[v + 1] - h.cellptr[v];
       int best = -1; i64 best_gain = 0;
       for (int p = 0; p < k; ++p) {
         if (p == pv || pw[p] + h.cwgt[v] > (i64)cap) continue;
-        i64 gn = (i64)leave_bonus - (deg - (i64)gain[p]);
+        i64 gn = leave_bonus - (degw - gain[p]);
         if (best == -1 || gn > best_gain) { best_gain = gn; best = p; }
       }
       if (best != -1) {
@@ -762,6 +883,11 @@ void partition_hypergraph_ml(const Hypergraph& h0, int k, double imbalance,
   std::vector<Hypergraph> levels;
   std::vector<MatchResult> maps;
   levels.push_back(h0);
+  // compact the working copy of the finest level too: a column-net
+  // hypergraph of an undirected graph has every net duplicated against its
+  // mirror, so identical-net merging halves even level-0 gain scans, and
+  // the weighted objective it produces is exactly the original km1
+  compact_nets(levels[0]);
   const i32 coarse_target = std::max(64, 24 * k);
   // skip nets with more pins than this during matching (cost control)
   while (levels.back().ncells > coarse_target) {
@@ -775,8 +901,11 @@ void partition_hypergraph_ml(const Hypergraph& h0, int k, double imbalance,
     levels.push_back(std::move(c));
   }
   if (timing)
-    std::fprintf(stderr, "[sgcnpart] coarsen: %.2fs levels=%zu coarsest=%d\n",
-                 secs(t0, now()), levels.size(), levels.back().ncells);
+    std::fprintf(stderr,
+                 "[sgcnpart] coarsen: %.2fs levels=%zu coarsest=%d "
+                 "(nets=%d pins=%zu)\n",
+                 secs(t0, now()), levels.size(), levels.back().ncells,
+                 levels.back().nnets, levels.back().netpins.size());
   double cap = (1.0 + imbalance) * (double)h0.total_cwgt / k;
   // multi-start at the coarsest level: keep the best refined candidate
   {
@@ -786,13 +915,29 @@ void partition_hypergraph_ml(const Hypergraph& h0, int k, double imbalance,
     i64 best_km1 = -1;
     std::vector<i32> best_part;
     PinCounts pc; pc.k = k;
-    const int trials = h0.ncells <= 2000 ? 16 : 8;  // tiny: search harder
+    // Column-net hypergraphs keep O(original pins / ~20) pins at the
+    // coarsest level (nets rarely become identical), so a coarse trial is
+    // O(pins·k·passes), NOT O(coarse cells) — budget the multistart by
+    // pins (r5 speed pass; at products scale 8 full trials were ~15% of
+    // total wall-clock for marginal quality: uncoarsening sweeps do the
+    // bulk of refinement anyway).
+    int trials = h0.ncells <= 2000 ? 16 : 8;
+    const i64 pins = (i64)hc.netpins.size();
+    if (pins > 2'000'000)
+      trials = std::max<int>(3, (int)(8 * 2'000'000 / pins));
     for (int trial = 0; trial < trials; ++trial) {
+      auto tg = now();
       std::vector<i32> cand;
       greedy_grow_h(hc, k, coarse_cap, cand, rng, trial % 2 == 1);
+      auto tr_ = now();
       refine_km1(hc, k, coarse_cap, cand, 8);
       build_pincounts(hc, cand, pc);
       i64 score = km1_total(hc, pc);
+      if (timing)
+        std::fprintf(stderr,
+                     "[sgcnpart]   trial %d: grow=%.2fs refine=%.2fs "
+                     "km1=%lld\n", trial, secs(tg, tr_), secs(tr_, now()),
+                     (long long)score);
       if (best_km1 < 0 || score < best_km1) {
         best_km1 = score; best_part = std::move(cand);
       }
@@ -813,8 +958,8 @@ void partition_hypergraph_ml(const Hypergraph& h0, int k, double imbalance,
                    levels[li].ncells, secs(tl, now()));
   }
   auto tr = now();
-  rebalance_km1(h0, k, cap, part);
-  refine_km1(h0, k, cap, part, 3);
+  rebalance_km1(levels[0], k, cap, part);
+  refine_km1(levels[0], k, cap, part, 3);
   if (timing)
     std::fprintf(stderr, "[sgcnpart] rebalance+final: %.2fs total=%.2fs\n",
                  secs(tr, now()), secs(t0, now()));

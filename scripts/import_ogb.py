@@ -54,12 +54,19 @@ from sgcn_tpu.io.datasets import (          # noqa: E402
 
 
 def _read_csv_gz(path: str, dtype):
-    """Tolerate both .csv.gz and plain .csv (ogb ships gz)."""
+    """Tolerate both .csv.gz and plain .csv (ogb ships gz).  pandas parses
+    the products-scale CSVs (~124M edge lines) orders of magnitude faster
+    than np.loadtxt; fall back only when pandas is absent."""
     if not os.path.exists(path) and path.endswith(".gz"):
         path = path[:-3]
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rt") as fh:
-        return np.loadtxt(fh, delimiter=",", dtype=dtype, ndmin=2)
+    try:
+        import pandas as pd
+        arr = pd.read_csv(path, header=None, dtype=dtype).to_numpy()
+        return np.atleast_2d(arr)
+    except ImportError:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt") as fh:
+            return np.loadtxt(fh, delimiter=",", dtype=dtype, ndmin=2)
 
 
 def _find_split_dir(root: str) -> str | None:
@@ -86,19 +93,27 @@ def import_ogb_raw(root: str):
     a = sp.coo_matrix((np.ones(len(src), np.float32), (src, dst)),
                       shape=(n, n)).tocsr()
     # symmetrize (arxiv is directed; products' one-direction edge list also
-    # needs the mirror) and drop duplicate weights back to 1
-    a = a.maximum(a.T)
+    # needs the mirror); COO->CSR summed duplicate edge lines to 2.0, so
+    # re-binarize explicitly — non-unit weights would multiply through
+    # normalize_adjacency into Â
+    a = sp.csr_matrix(a.maximum(a.T))
     a.setdiag(0)
     a.eliminate_zeros()
-    splits = {}
+    a.data[:] = 1.0
     sd = _find_split_dir(root)
-    if sd is not None:
-        for name in ("train", "valid", "test"):
-            idx = _read_csv_gz(os.path.join(sd, f"{name}.csv.gz"),
-                               np.int64).ravel()
-            m = np.zeros(n, np.float32)
-            m[idx] = 1.0
-            splits[f"{name}_mask"] = m
+    if sd is None:
+        raise FileNotFoundError(
+            f"no split directory under {root}/split — wrong nesting level "
+            f"(point at the dataset dir, e.g. .../ogbn_products) or a "
+            f"partial download; a silent empty-splits npz would only crash "
+            f"later in the trainer")
+    splits = {}
+    for name in ("train", "valid", "test"):
+        idx = _read_csv_gz(os.path.join(sd, f"{name}.csv.gz"),
+                           np.int64).ravel()
+        m = np.zeros(n, np.float32)
+        m[idx] = 1.0
+        splits[f"{name}_mask"] = m
     return a, feats, labels, splits
 
 

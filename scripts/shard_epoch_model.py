@@ -18,8 +18,9 @@ rank).  This script:
      exact padded exchange bytes over a bidirectional-ring ICI model
      (v5e: 45 GB/s one-way per link — the conservative 1D-ring reading of
      the 2x4 slice; the 2D torus routes all_to_all faster), and
-  5. writes ``bench_artifacts/shard_epoch_model[_dcsbm].json`` with the
-     composed 8-chip epoch-time model:
+  5. writes ``bench_artifacts/shard_epoch_model[_dcsbm][_bf16wire].json``
+     (the bf16-wire suffix keeps --halo-dtype runs from overwriting the
+     f32 baseline artifact) with the composed 8-chip epoch-time model:
         lower bound  max(compute, comm)   (XLA overlaps the a2a with the
                                            local slot passes — proven on the
                                            compiled v5e 8-chip schedule,
@@ -70,18 +71,11 @@ def ring_allreduce_seconds(grad_bytes: float, k: int) -> float:
 
 
 def exchange_widths(fin: int, widths: list[int]) -> list[int]:
-    """Per-layer exchanged row width (f32 lanes): the aggregation input
-    width under the trainer's project-first rule (models/gcn.py)."""
-    from sgcn_tpu.models.gcn import PROJECT_FIRST_MIN_FIN
+    """Per-layer exchanged row width: the trainer's project-first rule —
+    shared encoding lives in ``models/gcn.py::exchange_widths``."""
+    from sgcn_tpu.models.gcn import exchange_widths as ew
 
-    out, f = [], fin
-    for w in widths:
-        if w < f and f >= PROJECT_FIRST_MIN_FIN:
-            out.append(w)      # project first: exchange ships fout lanes
-        else:
-            out.append(f)      # aggregate first: exchange ships fin lanes
-        f = w
-    return out
+    return ew(fin, widths)
 
 
 def main() -> None:

@@ -44,6 +44,18 @@ def gcn_plan_fields(plan):
 PROJECT_FIRST_MIN_FIN = 256
 
 
+def exchange_widths(fin: int, widths) -> list[int]:
+    """Per-layer exchanged/aggregated row width (lanes) under the
+    project-first rule of ``gcn_forward_local`` — THE shared encoding of
+    that rule for every cost model (bench roofline, shard epoch model);
+    change the forward's condition and this together."""
+    out, f = [], fin
+    for w in widths:
+        out.append(w if (w < f and f >= PROJECT_FIRST_MIN_FIN) else f)
+        f = w
+    return out
+
+
 def init_gcn_params(rng: jax.Array, dims: list[tuple[int, int]]):
     """Glorot-uniform weight list, one (fin, fout) matrix per layer.
 
