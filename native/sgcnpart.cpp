@@ -549,13 +549,18 @@ MatchResult hc_matching(const Hypergraph& h, Rng& rng,
   // Per-cell candidate-scan budget (r5 speed pass): matching needs a
   // heavy-ish partner, not THE heaviest — capping pin touches bounds the
   // deg² term that dominated coarsening wall-clock at products scale.
-  // Nets arrive in arbitrary (graph-construction) order, so the truncated
-  // scan is an unbiased sample of v's nets.
+  // Per-cell net lists are SORTED by net id (rebuild_cellnets), and net
+  // ids follow vertex order, so a plain prefix would systematically favor
+  // low-id neighborhoods on id-structured families (BA ages, dcsbm
+  // communities) — start the truncated scan at a random rotation instead.
   const i64 scan_budget = 2048;
   for (i32 v : order) {
     if (match[v] != -1) continue;
     i64 budget = scan_budget;
-    for (i64 e = h.cellptr[v]; e < h.cellptr[v + 1] && budget > 0; ++e) {
+    const i64 vdeg = h.cellptr[v + 1] - h.cellptr[v];
+    const i64 rot = vdeg > 0 ? (i64)(rng.next() % (uint64_t)vdeg) : 0;
+    for (i64 i = 0; i < vdeg && budget > 0; ++i) {
+      const i64 e = h.cellptr[v] + (i + rot) % vdeg;
       i32 net = h.cellnets[e];
       i64 deg = h.netptr[net + 1] - h.netptr[net];
       if (deg > big_net_threshold) continue;        // skip huge nets (cost)

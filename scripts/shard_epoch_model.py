@@ -70,12 +70,6 @@ def ring_allreduce_seconds(grad_bytes: float, k: int) -> float:
     return 2 * (k - 1) / k * grad_bytes / W_LINK
 
 
-def exchange_widths(fin: int, widths: list[int]) -> list[int]:
-    """Per-layer exchanged row width: the trainer's project-first rule —
-    shared encoding lives in ``models/gcn.py::exchange_widths``."""
-    from sgcn_tpu.models.gcn import exchange_widths as ew
-
-    return ew(fin, widths)
 
 
 def main() -> None:
@@ -101,6 +95,7 @@ def main() -> None:
                 f"{args.models!r}")   # fail BEFORE minutes of graph/plan build
 
     from bench import diff_time_q
+    from sgcn_tpu.models.gcn import exchange_widths
     from sgcn_tpu.parallel import build_comm_plan
     from sgcn_tpu.parallel.proxy import shard_proxy_data, shard_proxy_plan
     from sgcn_tpu.prep import normalize_adjacency
@@ -233,6 +228,21 @@ def main() -> None:
 
     dt = "" if args.halo_dtype == "float32" else "_bf16wire"
     path = os.path.join(ART, f"shard_epoch_model{suffix}{dt}.json")
+    if os.path.exists(path):
+        # merge: a partial re-run (e.g. after a tunnel flake killed one
+        # model's measurement) must not discard the other model's entry —
+        # but ONLY under the identical config; a changed config would
+        # mislabel the kept measurement
+        with open(path) as fh:
+            prev = json.load(fh)
+        if prev.get("config") == out["config"]:
+            for key, val in out.items():
+                if key in ("gcn", "gat") and "error" in val and \
+                        isinstance(prev.get(key), dict) and \
+                        "error" not in prev[key]:
+                    continue        # keep the previous GOOD measurement
+                prev[key] = val
+            out = prev
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(out, fh, indent=1)
