@@ -18,9 +18,9 @@ rank).  This script:
      exact padded exchange bytes over a bidirectional-ring ICI model
      (v5e: 45 GB/s one-way per link — the conservative 1D-ring reading of
      the 2x4 slice; the 2D torus routes all_to_all faster), and
-  5. writes ``bench_artifacts/shard_epoch_model[_dcsbm][_bf16wire].json``
-     (the bf16-wire suffix keeps --halo-dtype runs from overwriting the
-     f32 baseline artifact) with the composed 8-chip epoch-time model:
+  5. writes ``bench_artifacts/shard_epoch_model[_dcsbm][_bf16wire|_abwire]
+     .json`` (dtype-suffixed so --halo-dtype runs never overwrite the f32
+     baseline artifact) with the composed 8-chip epoch-time model:
         lower bound  max(compute, comm)   (XLA overlaps the a2a with the
                                            local slot passes — proven on the
                                            compiled v5e 8-chip schedule,
@@ -33,7 +33,9 @@ after warm-up (``GPU/PGCN.py:202-228``, ``Parallel-GCN/main.c:441-445``).
 Usage:
   PYTHONPATH=/root/repo python scripts/shard_epoch_model.py
       [--graph ba|dcsbm] [--chip 0] [--models gcn,gat] [--epochs 4]
-      [--halo-dtype float32|bfloat16]
+      [--halo-dtype float32|bfloat16|ab]
+  ('ab' measures the f32 AND bf16 wire back to back under ONE plan — the
+  drift-proof same-session comparison; GCN only)
 """
 
 from __future__ import annotations
@@ -80,9 +82,12 @@ def main() -> None:
                    help="comma list drawn from {gcn, gat}")
     p.add_argument("--epochs", type=int, default=4)
     p.add_argument("--halo-dtype", default="float32",
-                   choices=["float32", "bfloat16"],
+                   choices=["float32", "bfloat16", "ab"],
                    help="dtype of the a2a halo buffer (exchange-only bf16 "
-                        "halves ICI bytes; tables/activations stay f32)")
+                        "halves ICI bytes; tables/activations stay f32). "
+                        "'ab' measures BOTH under one plan in one session "
+                        "— the only drift-proof comparison at GB-table "
+                        "scale (BASELINE.md rate-drift caveat)")
     p.add_argument("--fin", type=int, default=128)
     p.add_argument("--hidden", type=int, default=128)
     p.add_argument("--classes", type=int, default=40)
@@ -93,6 +98,10 @@ def main() -> None:
     if bad or not models:
         p.error(f"--models must be a comma list from {{gcn,gat}}, got "
                 f"{args.models!r}")   # fail BEFORE minutes of graph/plan build
+    if args.halo_dtype == "ab" and models != ["gcn"] \
+            and args.models != "gcn,gat":   # explicit non-gcn request
+        p.error("--halo-dtype ab measures the GCN wire A/B only; "
+                "drop --models or pass --models gcn")
 
     from bench import diff_time_q
     from sgcn_tpu.models.gcn import exchange_widths
@@ -170,10 +179,19 @@ def main() -> None:
     # packed compute_dtype path) — its wire is modeled f32 regardless; it
     # ships the POST-projection [p ‖ u] rows (fout + 1 lanes per layer),
     # not the GCN's project-first-rule widths
-    comm_by_model = {"gcn": comm_model(args.halo_dtype, ew),
-                     "gat": comm_model("float32",
-                                       [w + 1 for w in widths])}
-    print("comm model (gcn):", json.dumps(comm_by_model["gcn"]), flush=True)
+    if args.halo_dtype == "ab":
+        # same-session wire A/B: one plan, one device data placement, both
+        # wire dtypes measured back to back — the drift-proof form
+        jobs = [("gcn", "gcn", "float32"),
+                ("gcn_bf16wire", "gcn", "bfloat16")]
+    else:
+        jobs = [(m, m, args.halo_dtype if m == "gcn" else "float32")
+                for m in models]
+    comm_by_entry = {
+        entry: comm_model(dt, ew if model == "gcn"
+                          else [w + 1 for w in widths])
+        for entry, model, dt in jobs}
+    print("comm model:", json.dumps(comm_by_entry[jobs[0][0]]), flush=True)
 
     # ------------------------------------------------- measured compute leg
     out = {
@@ -183,23 +201,23 @@ def main() -> None:
             "partitioner": "hp",
             "plan": {"b": plan.b, "s": plan.s, "r": plan.r, "e": plan.e},
         },
-        "comm": comm_by_model,
+        "comm": comm_by_entry,
         "protocol": "per-chip shard program measured on the real v5e chip "
                     "(differential, median of 3); collectives modeled from "
                     "the plan's padded exchange bytes",
     }
-    for model in models:
-        comm = comm_by_model[model]
+    for entry, model, wire_dt in jobs:
+        comm = comm_by_entry[entry]
         t0 = time.time()
         try:
             kw = ({"activation": "none"} if model == "gat" else
-                  ({"halo_dtype": args.halo_dtype}
-                   if args.halo_dtype != "float32" else {}))
+                  ({"halo_dtype": wire_dt}
+                   if wire_dt != "float32" else {}))
             tr = FullBatchTrainer(proxy, fin=args.fin, widths=widths,
                                   seed=2, model=model, **kw)
         except MemoryError as e:
-            out[model] = {"error": f"capacity guard: {e}"}
-            print(f"{model}: {out[model]}", flush=True)
+            out[entry] = {"error": f"capacity guard: {e}"}
+            print(f"{entry}: {out[entry]}", flush=True)
             continue
 
         def make_run(nep):
@@ -212,21 +230,22 @@ def main() -> None:
             compute_s, n_clean = diff_time_q(make_run, 1,
                                              max(3, args.epochs))
         except RuntimeError as e:
-            out[model] = {"error": f"measurement failed: {e}"}
-            print(f"{model}: {out[model]}", flush=True)
+            out[entry] = {"error": f"measurement failed: {e}"}
+            print(f"{entry}: {out[entry]}", flush=True)
             continue
         comm_s = comm["comm_s_per_epoch"]
-        out[model] = {
+        out[entry] = {
             "per_chip_compute_s": compute_s,
             "clean_estimates": n_clean,
             "setup_plus_measure_s": round(time.time() - t0, 1),
             "epoch_s_8chip_model": compute_s + comm_s,
             "epoch_s_8chip_model_overlapped": max(compute_s, comm_s),
         }
-        print(f"{model}: {json.dumps(out[model])}", flush=True)
+        print(f"{entry}: {json.dumps(out[entry])}", flush=True)
         del tr
 
-    dt = "" if args.halo_dtype == "float32" else "_bf16wire"
+    dt = {"float32": "", "bfloat16": "_bf16wire",
+          "ab": "_abwire"}[args.halo_dtype]
     path = os.path.join(ART, f"shard_epoch_model{suffix}{dt}.json")
     if os.path.exists(path):
         # merge: a partial re-run (e.g. after a tunnel flake killed one
@@ -237,10 +256,13 @@ def main() -> None:
             prev = json.load(fh)
         if prev.get("config") == out["config"]:
             for key, val in out.items():
-                if key in ("gcn", "gat") and "error" in val and \
+                # any measurement entry (gcn / gat / gcn_bf16wire / ...):
+                # never overwrite a previous GOOD number with a new error
+                if isinstance(val, dict) and "error" in val and \
                         isinstance(prev.get(key), dict) and \
-                        "error" not in prev[key]:
-                    continue        # keep the previous GOOD measurement
+                        "error" not in prev[key] and \
+                        "per_chip_compute_s" in prev[key]:
+                    continue
                 prev[key] = val
             out = prev
     tmp = path + ".tmp"
