@@ -23,6 +23,7 @@
 
 #include <algorithm>
 #include <chrono>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -970,6 +971,90 @@ void partition_hypergraph_ml(const Hypergraph& h0, int k, double imbalance,
                  secs(tr, now()), secs(t0, now()));
 }
 
+// ---------------------------------------------------- recursive bisection
+// Direct k-way km1 refinement costs O(deg·k) per move, which at k >= 32 and
+// products scale made hp both slow (5 700 s) and ~3% WORSE than gp
+// (BASELINE.md round-5 k-sweep).  Recursive bisection — the PaToH/hMETIS
+// production strategy — eliminates the k factor: log2(k) levels of 2-way
+// partitions, each with the full multilevel machinery at k=2.
+//
+// The km1 objective decomposes EXACTLY over a bisection with net
+// splitting: for a net with pins on both sides, λ over the final k parts
+// equals λ_left + λ_right (its sub-nets' part counts), so
+//   km1(net) = λ−1 = (λ_left−1) + (λ_right−1) + 1,
+// i.e. total km1 = (top-level cut nets) + Σ_side km1(side sub-hypergraph)
+// where each side keeps the net restricted to its own pins.  Minimizing
+// the 2-way cut then recursing on split nets IS minimizing km1.
+// Per-level imbalance halves (ε/2 each level) so the final parts respect
+// the caller's cap.  Power-of-two k only (even splits); other k use the
+// direct k-way driver.
+void partition_hypergraph_rb(const Hypergraph& h, int k, double imbalance,
+                             int seed, std::vector<i32>& part) {
+  if (k == 1) { part.assign(h.ncells, 0); return; }
+  // split the imbalance budget GEOMETRICALLY over the remaining levels:
+  // (1+ε_level)^levels == 1+ε exactly, so the final parts respect the
+  // caller's cap without the additive-halving scheme's two failure modes
+  // (deep levels starved below one cell of slack — refinement frozen —
+  // and compounded overshoot at large ε).  The per-level slack is floored
+  // at one max cell weight so a feasible move always exists.
+  const int levels = [] (int kk) {
+    int l = 0; while (kk > 1) { kk >>= 1; ++l; } return l; } (k);
+  double eps_level = std::pow(1.0 + imbalance, 1.0 / levels) - 1.0;
+  const i64 max_cw = h.cwgt.empty() ? 1 :
+      *std::max_element(h.cwgt.begin(), h.cwgt.end());
+  if (h.total_cwgt > 0)
+    eps_level = std::max(eps_level, 2.0 * (double)max_cw / h.total_cwgt);
+  std::vector<i32> top;
+  // the k==2 base case gets the level budget like any other level (the
+  // recursion has already consumed the rest of ε above it; when called
+  // directly with k==2, levels==1 makes eps_level == imbalance)
+  partition_hypergraph_ml(h, 2, eps_level, seed, top);
+  if (k == 2) { part = top; return; }
+  const double eps_rem =
+      std::pow(1.0 + imbalance, (levels - 1.0) / levels) - 1.0;
+  part.assign(h.ncells, -1);
+  for (int side = 0; side < 2; ++side) {
+    // extract the side's sub-hypergraph: cells of this side, nets
+    // restricted to their pins on this side (< 2 pins -> dropped, they
+    // can no longer be cut), weights carried
+    std::vector<i32> cells;                    // sub id -> parent id
+    std::vector<i32> sub_of(h.ncells, -1);
+    for (i32 v = 0; v < h.ncells; ++v)
+      if (top[v] == side) {
+        sub_of[v] = (i32)cells.size();
+        cells.push_back(v);
+      }
+    Hypergraph s;
+    s.ncells = (i32)cells.size();
+    s.cwgt.resize(s.ncells);
+    for (i32 sv = 0; sv < s.ncells; ++sv) s.cwgt[sv] = h.cwgt[cells[sv]];
+    s.total_cwgt = std::accumulate(s.cwgt.begin(), s.cwgt.end(), (i64)0);
+    s.netptr.push_back(0);
+    for (i32 j = 0; j < h.nnets; ++j) {
+      i64 kept = 0;
+      for (i64 p = h.netptr[j]; p < h.netptr[j + 1]; ++p)
+        if (sub_of[h.netpins[p]] >= 0) {
+          s.netpins.push_back(sub_of[h.netpins[p]]);
+          ++kept;
+        }
+      if (kept < 2) {
+        s.netpins.resize(s.netpins.size() - kept);   // drop
+      } else {
+        s.netptr.push_back((i64)s.netpins.size());
+        s.nwgt.push_back(h.nwgt.empty() ? 1 : h.nwgt[j]);
+      }
+    }
+    s.nnets = (i32)s.nwgt.size();
+    rebuild_cellnets(s);
+    std::vector<i32> sub_part;
+    partition_hypergraph_rb(s, k / 2, eps_rem, seed + 104729 + side,
+                            sub_part);
+    const int off = side * (k / 2);
+    for (i32 sv = 0; sv < s.ncells; ++sv)
+      part[cells[sv]] = off + sub_part[sv];
+  }
+}
+
 // Restart budget: whole-multilevel restarts are the "more V-cycles" quality
 // lever, but they scale linearly in the instance size, so the budget is
 // size-capped (the VERDICT-r3 scale path: one restart at products scale keeps
@@ -1044,8 +1129,25 @@ int sgcn_partition_hypergraph(i32 ncells, i32 nnets, const i64* cellptr,
     i64 best = -1;
     std::vector<i32> cand;
     PinCounts pc; pc.k = k;
+    // high power-of-two k: recursive bisection (see
+    // partition_hypergraph_rb) replaces the direct k-way driver, whose
+    // O(deg·k) refinement measured slower AND worse at k >= 32;
+    // SGCN_HP_RB=1 forces RB wherever k is a power of two, =0 disables
+    const char* rb_env = std::getenv("SGCN_HP_RB");
+    const bool pow2 = (k & (k - 1)) == 0;
+    const bool use_rb = pow2 && rb_env != nullptr ? rb_env[0] == '1'
+                        : pow2 && k >= 32;
     for (int r = 0; r < restarts; ++r) {
-      partition_hypergraph_ml(h, k, imbalance, seed + 7919 * r, cand);
+      if (use_rb)
+        partition_hypergraph_rb(h, k, imbalance, seed + 7919 * r, cand);
+      else
+        partition_hypergraph_ml(h, k, imbalance, seed + 7919 * r, cand);
+      double cap = (1.0 + imbalance) * (double)h.total_cwgt / k;
+      if (use_rb) {
+        // one direct k-way polish pass: RB never saw cross-side moves
+        rebalance_km1(h, k, cap, cand);
+        refine_km1(h, k, cap, cand, 2);
+      }
       build_pincounts(h, cand, pc);
       i64 score = km1_total(h, pc);
       if (best < 0 || score < best) { best = score; part = cand; }
@@ -1234,6 +1336,7 @@ void sgcn_free(void* ptr) { std::free(ptr); }
 // GPU/graph + GPU/hypergraph partvec generators.
 #ifdef SGCNPART_MAIN
 #include <chrono>
+#include <cmath>
 #include <fstream>
 #include <sstream>
 
