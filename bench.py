@@ -110,8 +110,56 @@ def diff_time_q(make_run, lo: int, hi: int, reps: int = 5,
         f"t({lo} ep)={t_lo:.4f}s in every attempt (chip contention?)")
 
 
+class _PhaseDeadlineExpired(RuntimeError):
+    """A bench phase exceeded its own deadline (degraded, not a bug)."""
+
+
+class _phase_deadline:
+    """SIGALRM watchdog for an in-process phase: raises
+    ``_PhaseDeadlineExpired`` when ``seconds`` elapse (0/None = off).
+
+    Best-effort by design — the alarm interrupts at the next Python
+    bytecode, so a wedged C call (a hung TPU tunnel) can outlive it; the
+    subprocess phases carry their own hard timeouts for that case."""
+
+    def __init__(self, seconds: float | None, phase: str):
+        self.seconds = seconds or 0
+        self.phase = phase
+
+    def __enter__(self):
+        if self.seconds > 0:
+            import signal
+
+            def fire(signum, frame):
+                raise _PhaseDeadlineExpired(
+                    f"{self.phase} phase exceeded its {self.seconds:.0f}s "
+                    "deadline")
+
+            self._old = signal.signal(signal.SIGALRM, fire)
+            signal.alarm(int(self.seconds))
+        return self
+
+    def __exit__(self, *exc):
+        if self.seconds > 0:
+            import signal
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, self._old)
+        return False
+
+
+def _backend_unavailable(e: Exception) -> bool:
+    """Classify an exception as "the accelerator backend is unavailable"
+    (skip with a marker) vs a genuine code failure (propagate) — the shared
+    classifier, so this path and the driver's stay in agreement."""
+    from sgcn_tpu.utils.backend import looks_backend_unavailable
+
+    return looks_backend_unavailable(f"{type(e).__name__}: {e}")
+
+
 def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
-              dtype: str | None = None, remat: bool = False):
+              dtype: str | None = None, remat: bool = False,
+              halo_staleness: int = 0, halo_delta: bool = False,
+              sync_every: int = 0, step_dispatch: bool = False):
     import jax
 
     # The axon sitecustomize pre-registers the TPU plugin at interpreter
@@ -144,6 +192,11 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
     # PGAT semantics: bare stacked modules, no inter-layer activation
     # (GPU/PGAT.py:202-213; same default as the trainer CLI)
     kw = {"model": "gat", "activation": "none"} if model == "gat" else {}
+    if halo_staleness:
+        kw.update(halo_staleness=halo_staleness, halo_delta=halo_delta,
+                  sync_every=sync_every)
+        part_metrics.update(halo_staleness=halo_staleness,
+                            halo_delta=halo_delta, sync_every=sync_every)
     trainer = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths,
                                mesh=mesh, compute_dtype=dtype, remat=remat,
                                **kw)
@@ -152,24 +205,51 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
     # DIFFERENTIAL timing (round-3 protocol, see diff_time): the reference's
     # "timed epochs after warm-up" quantity (GPU/PGCN.py:202-228) free of
     # the tunnel's per-dispatch constant.
-    def make_run(nep):
-        def run():
-            losses = trainer.run_epochs(data, nep, sync=False)
-            return float(losses[-1])              # scalar readback = sync
-        return run
+    #
+    # ``step_dispatch`` times one step() dispatch per epoch instead of the
+    # fused on-device fori sweep — the stale-pipelining A/B runs both arms
+    # this way: the CPU runtime overlaps the stale mode's consumer-less
+    # all_to_all across step boundaries in per-step dispatch, but executes
+    # fori bodies without that freedom, so the fused sweep would hide the
+    # very effect being measured (dispatch cost still cancels in the
+    # differential).
+    if step_dispatch:
+        def make_run(nep):
+            def run():
+                loss = None
+                for _ in range(nep):
+                    loss = trainer.step(data, sync=False)
+                return float(loss)        # in-order dispatch: syncs the run
+            return run
+    else:
+        def make_run(nep):
+            def run():
+                losses = trainer.run_epochs(data, nep, sync=False)
+                return float(losses[-1])          # scalar readback = sync
+            return run
 
     epoch_s = diff_time(make_run, 1, max(3, epochs))
     if model == "gcn" and plan.symmetric:
-        # roofline self-description (VERDICT r4 item 7): achieved gathered
-        # GB/s vs the measured stream ceiling.  Plan fields are per-chip
-        # padded sizes, so this is per-chip traffic (= global when k=1);
-        # bf16 compute gathers 2-byte lanes
-        gb = gather_bytes_per_epoch(plan, feats.shape[1], widths,
-                                    itemsize=2 if dtype == "bfloat16" else 4)
-        part_metrics["gather_GB_per_epoch_per_chip"] = round(gb / 1e9, 3)
-        part_metrics["achieved_gather_GBs"] = round(gb / epoch_s / 1e9, 1)
-        part_metrics["stream_ceiling_frac"] = round(
-            gb / epoch_s / 1e9 / STREAM_CEILING_GBS, 3)
+        if "pallas_tb" in trainer._fwd_static:
+            # the trainer auto-selected the Pallas VMEM aggregator: the ELL
+            # gather model below does not describe the compiled program, so
+            # emitting achieved_gather_GBs / stream_ceiling_frac would
+            # describe a program that didn't run — say so instead
+            part_metrics["roofline_skipped"] = (
+                "pallas aggregator selected (plan tables fit VMEM); the ELL "
+                "gather-stream roofline does not describe this program")
+        else:
+            # roofline self-description (VERDICT r4 item 7): achieved
+            # gathered GB/s vs the measured stream ceiling.  Plan fields are
+            # per-chip padded sizes, so this is per-chip traffic (= global
+            # when k=1); bf16 compute gathers 2-byte lanes
+            gb = gather_bytes_per_epoch(
+                plan, feats.shape[1], widths,
+                itemsize=2 if dtype == "bfloat16" else 4)
+            part_metrics["gather_GB_per_epoch_per_chip"] = round(gb / 1e9, 3)
+            part_metrics["achieved_gather_GBs"] = round(gb / epoch_s / 1e9, 1)
+            part_metrics["stream_ceiling_frac"] = round(
+                gb / epoch_s / 1e9 / STREAM_CEILING_GBS, 3)
     return epoch_s, part_metrics
 
 
@@ -334,21 +414,12 @@ def bench_torch_reference(ahat, feats, labels, widths, epochs: int) -> float:
     return (time.perf_counter() - t0) / epochs
 
 
-def bench_vdev_partitioned(n: int, avg_deg: int, f: int, widths, epochs: int,
-                           graph: str = "ba"):
-    """Measure the actual distributed algorithm on a virtual 8-device CPU
-    mesh: hp-partitioned graph, real halo exchanges (all_to_all) every layer,
-    grad psum — the paper's core protocol (GPU/PGCN.py:202-238) — even though
-    this box exposes one TPU chip.  Re-execs this script in a subprocess with
-    the conftest env (``__graft_entry__._virtual_mesh_env`` recipe) and parses
-    its one-line JSON.  Returns {} on any child failure (the flagship number
-    must not die with the diagnostic one).
-
-    The child graph defaults to the power-law (ba) family — the profile of
-    the real ogbn graphs — and the child partitions live with one multilevel
-    restart (SGCN_RESTARTS=1) so the partitioner fits the child's time
-    budget; the full-restart partitioner quality evidence lives in the
-    products_partition artifact instead."""
+def _run_vdev_child(n: int, avg_deg: int, f: int, widths, epochs: int,
+                    graph: str, extra_args=(), timeout_s: int = 1200):
+    """Run one flagship config on the virtual 8-device CPU mesh in a
+    subprocess (``__graft_entry__._virtual_mesh_env`` recipe) and return its
+    parsed one-line JSON.  Raises on child failure/timeout — callers decide
+    how to degrade."""
     env = dict(os.environ)
     flags = [x for x in env.get("XLA_FLAGS", "").split()
              if "xla_force_host_platform_device_count" not in x]
@@ -360,14 +431,32 @@ def bench_vdev_partitioned(n: int, avg_deg: int, f: int, widths, epochs: int,
            "-n", str(n), "--avg-deg", str(avg_deg), "-f", str(f),
            "--hidden", str(widths[0]), "--classes", str(widths[-1]),
            "-l", str(len(widths)), "-e", str(epochs), "--skip-torch",
-           "--graph", graph]
+           "--graph", graph, *extra_args]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout_s,
+                          cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(f"rc={proc.returncode}: {proc.stderr[-500:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_vdev_partitioned(n: int, avg_deg: int, f: int, widths, epochs: int,
+                           graph: str = "ba"):
+    """Measure the actual distributed algorithm on a virtual 8-device CPU
+    mesh: hp-partitioned graph, real halo exchanges (all_to_all) every layer,
+    grad psum — the paper's core protocol (GPU/PGCN.py:202-238) — even though
+    this box exposes one TPU chip.  Re-execs this script in a subprocess with
+    the conftest env and parses its one-line JSON.  Returns a degraded
+    partial block on any child failure (the flagship number must not die
+    with the diagnostic one).
+
+    The child graph defaults to the power-law (ba) family — the profile of
+    the real ogbn graphs — and the child partitions live with one multilevel
+    restart (SGCN_RESTARTS=1) so the partitioner fits the child's time
+    budget; the full-restart partitioner quality evidence lives in the
+    products_partition artifact instead."""
     try:
-        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                              timeout=1200,
-                              cwd=os.path.dirname(os.path.abspath(__file__)))
-        if proc.returncode != 0:
-            raise RuntimeError(f"rc={proc.returncode}: {proc.stderr[-500:]}")
-        child = json.loads(proc.stdout.strip().splitlines()[-1])
+        child = _run_vdev_child(n, avg_deg, f, widths, epochs, graph)
         return {
             "epoch_s_8dev_cpu": child["value"],
             "n_8dev": n,
@@ -377,9 +466,132 @@ def bench_vdev_partitioned(n: int, avg_deg: int, f: int, widths, epochs: int,
             "comm_volume_rows_8dev": child.get("comm_volume_rows"),
             "comm_messages_8dev": child.get("comm_messages"),
         }
+    except subprocess.TimeoutExpired as e:      # noqa: F841 — diagnostic path
+        print("# vdev8 run exceeded its deadline", file=sys.stderr)
+        return {"epoch_s_8dev_cpu": None, "vdev_degraded": "deadline"}
     except Exception as e:                      # noqa: BLE001 — diagnostic path
         print(f"# vdev8 run failed: {e!r}", file=sys.stderr)
-        return {"epoch_s_8dev_cpu": None}
+        return {"epoch_s_8dev_cpu": None, "vdev_degraded": repr(e)[:200]}
+
+
+def bench_stale_ab(n: int, avg_deg: int, f: int, widths, epochs: int,
+                   graph: str):
+    """A/B the exact vs pipelined (staleness-1) exchange on the 8-virtual-
+    device CPU mesh — the measurable form of "the exchange left the critical
+    path" this box can produce without an 8-chip ICI mesh.  BOTH arms run in
+    ONE child process (``--stale-ab-child``), sharing the graph, partition,
+    plan, data and process state, interleaved exact→stale→exact — the
+    between-process variance of separate children (~±20% on a 2-core host)
+    is larger than the effect and would make the comparison a coin flip.
+    Degrades to a marked partial block on child failure."""
+    block: dict = {"stale_ab_8dev": None}
+    try:
+        child = _run_vdev_child(
+            n, avg_deg, f, widths, epochs, graph,
+            extra_args=("--stale-ab-child",))
+        child.pop("metric", None)
+        child.pop("value", None)
+        block["stale_ab_8dev"] = child
+        return block
+    except subprocess.TimeoutExpired:
+        print("# stale A/B run exceeded its deadline", file=sys.stderr)
+        block["stale_ab_degraded"] = "deadline"
+        return block
+    except Exception as e:                      # noqa: BLE001 — diagnostic path
+        print(f"# stale A/B run failed: {e!r}", file=sys.stderr)
+        block["stale_ab_degraded"] = repr(e)[:200]
+        return block
+
+
+def bench_stale_ab_child(ahat, feats, labels, widths, epochs: int,
+                         graph: str) -> dict:
+    """One-process exact-vs-staleness-1 A/B (the ``--stale-ab-child`` body).
+
+    One plan, one mesh, both trainers; per-step dispatch timing for both
+    arms (the mode in which the runtime may float the stale a2a across the
+    step boundary — a fused fori sweep executes loop bodies without that
+    freedom and hides the effect).  The exact arm is timed BEFORE and AFTER
+    the stale arm and averaged, so slow machine drift cancels instead of
+    crediting either arm.  The stale arm is pure pipelining: stale feature
+    and gradient exchanges, no delta wire, no periodic sync."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from sgcn_tpu.parallel import build_comm_plan, make_mesh_1d
+    from sgcn_tpu.parallel.mesh import shard_stacked
+    from sgcn_tpu.partition import partition_hypergraph_colnet
+    from sgcn_tpu.train import FullBatchTrainer, make_train_data
+
+    k = len(jax.devices())
+    n = ahat.shape[0]
+    if k > 1:
+        pv, km1 = partition_hypergraph_colnet(ahat, k, seed=0)
+    else:
+        pv, km1 = np.zeros(n, dtype=np.int64), 0
+    plan = build_comm_plan(ahat, pv, k)
+    mesh = make_mesh_1d(k)
+    data = make_train_data(plan, feats, labels)
+    data = type(data)(**shard_stacked(mesh, vars(data)))
+
+    def arm(**kw):
+        tr = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths,
+                              mesh=mesh, **kw)
+
+        def make_run(nep):
+            def run():
+                loss = None
+                for _ in range(nep):
+                    loss = tr.step(data, sync=False)
+                return float(loss)    # in-order dispatch syncs the run
+            return run
+        return make_run
+
+    # Pair the arms at the REP level: this 2-core host drifts by tens of
+    # percent over minutes (measured exact-arm pre/post spreads up to 1.6×),
+    # so two separately-timed phases — or two separate child processes —
+    # turn a <10% effect into a coin flip.  Each rep times the four runs
+    # (exact lo/hi, stale lo/hi) back to back within seconds, forms BOTH
+    # differentials from the same machine state, and the medians over reps
+    # are compared.
+    exact_mk, stale_mk = arm(), arm(halo_staleness=1)
+    nep = max(8, epochs)
+    runs = [exact_mk(1), exact_mk(nep), stale_mk(1), stale_mk(nep)]
+    for r in runs:
+        r()                                   # compile + warm, retired
+    e_lo, e_hi, s_lo, s_hi = runs
+
+    def timed(run):
+        t0 = time.perf_counter()
+        v = run()
+        dt = time.perf_counter() - t0
+        if not np.isfinite(v):
+            raise RuntimeError(f"non-finite loss {v}")
+        return dt
+
+    d_exact, d_stale = [], []
+    for _ in range(6):
+        te_lo, ts_lo = timed(e_lo), timed(s_lo)
+        te_hi, ts_hi = timed(e_hi), timed(s_hi)
+        if te_hi > te_lo and ts_hi > ts_lo:
+            d_exact.append((te_hi - te_lo) / (nep - 1))
+            d_stale.append((ts_hi - ts_lo) / (nep - 1))
+    if not d_exact:
+        raise RuntimeError("stale A/B: no clean paired differentials")
+    exact_s = statistics.median(d_exact)
+    stale_s = statistics.median(d_stale)
+    return {
+        "epoch_s_exact": round(exact_s, 6),
+        "epoch_s_stale1": round(stale_s, 6),
+        # the A/B delta IS the exposed-comm time estimate: same program
+        # minus the per-layer exchange dependence
+        "exposed_comm_s_estimate": round(exact_s - stale_s, 6),
+        "stale_speedup": round(exact_s / stale_s, 3),
+        "clean_pairs": len(d_exact),
+        "n": n, "graph": graph, "km1": int(km1),
+        "timing": "per-step dispatch, one process, rep-level paired "
+                  "differentials (see bench_stale_ab_child)",
+    }
 
 
 def bench_ab_baseline(args, rev: str) -> dict:
@@ -552,6 +764,32 @@ def main() -> None:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize layer activations in the backward "
                         "(HBM-for-FLOPs trade for huge vertex counts)")
+    p.add_argument("--halo-staleness", type=int, default=0, choices=[0, 1],
+                   help="1 = pipelined one-step-stale halo exchange (the "
+                        "a2a leaves the critical path; GCN symmetric only)")
+    p.add_argument("--halo-delta", action="store_true",
+                   help="halo-delta cache: boundary rows ship as bf16 "
+                        "deltas accumulated into the carried halo "
+                        "(requires --halo-staleness 1)")
+    p.add_argument("--sync-every", type=int, default=0,
+                   help="stale mode: run a full-sync (exact) step every N "
+                        "steps to bound drift (0 = only the first step)")
+    p.add_argument("--skip-stale-ab", action="store_true",
+                   help="skip the exact-vs-staleness-1 A/B on the virtual "
+                        "8-device mesh")
+    p.add_argument("--stale-ab-n", type=int, default=40_000,
+                   help="graph size for the stale A/B children (two extra "
+                        "CPU-mesh runs; smaller than --vdev-n by default)")
+    p.add_argument("--step-dispatch", action="store_true",
+                   help="time one step() dispatch per epoch instead of the "
+                        "fused on-device epoch loop (the stale A/B timing "
+                        "mode)")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="flagship-phase deadline in seconds; on expiry the "
+                        "bench emits a degraded partial JSON (rc 0) instead "
+                        "of dying to an external timeout.  Default: "
+                        "$SGCN_BENCH_DEADLINE, else 840s for sub-1M-vertex "
+                        "runs and off at GB-table scale")
     p.add_argument("--graph", default="er",
                    choices=["er", "ba", "dcsbm"],
                    help="synthetic graph family: er (no hubs) or ba "
@@ -571,7 +809,16 @@ def main() -> None:
                    help="graph family for the virtual-8-device run "
                         "(default ba: the ogbn-like power-law profile)")
     p.add_argument("--vdev-child", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--stale-ab-child", action="store_true",
+                   help=argparse.SUPPRESS)
     args = p.parse_args()
+
+    if (args.halo_delta or args.sync_every) and not args.halo_staleness:
+        # match the trainer CLI: silently measuring exact mode while the
+        # JSON reader believes it was the delta wire would be a lie
+        raise SystemExit(
+            "--halo-delta/--sync-every configure the stale pipelined "
+            "exchange; add --halo-staleness 1")
 
     from sgcn_tpu.prep import normalize_adjacency
     a = synth_graph(args.n, args.avg_deg, kind=args.graph)
@@ -580,6 +827,15 @@ def main() -> None:
     feats = rng.standard_normal((args.n, args.f)).astype(np.float32)
     labels = rng.integers(0, args.classes, size=args.n).astype(np.int32)
     widths = [args.hidden] * (args.layers - 1) + [args.classes]
+
+    if args.stale_ab_child:
+        print(json.dumps({
+            "metric": "stale_ab",
+            "value": None,      # the arm fields below are the payload
+            **bench_stale_ab_child(ahat, feats, labels, widths, args.epochs,
+                                   graph=args.graph),
+        }))
+        return
 
     if args.batch_size is not None:
         if args.model != "gcn":
@@ -604,9 +860,36 @@ def main() -> None:
         }))
         return
 
-    epoch_s, part_metrics = bench_jax(ahat, feats, labels, widths, args.epochs,
-                                      model=args.model, dtype=args.dtype,
-                                      remat=args.remat)
+    # graceful degradation (round-5 verdict headline): a missing TPU backend
+    # or a blown phase deadline must yield a VALID partial JSON with a
+    # skipped/degraded marker, not rc=1/rc=124.  Genuine code bugs still
+    # raise.
+    deadline = args.deadline
+    if deadline is None:
+        deadline = float(os.environ.get("SGCN_BENCH_DEADLINE", "0")) or \
+            (840.0 if args.n < 1_000_000 else 0.0)
+    partial = {
+        "metric": f"fullbatch_{args.model}_epoch_time",
+        "value": None, "unit": "s", "graph": args.graph,
+    }
+    try:
+        with _phase_deadline(deadline, "flagship"):
+            epoch_s, part_metrics = bench_jax(
+                ahat, feats, labels, widths, args.epochs,
+                model=args.model, dtype=args.dtype, remat=args.remat,
+                halo_staleness=args.halo_staleness,
+                halo_delta=args.halo_delta, sync_every=args.sync_every,
+                step_dispatch=args.step_dispatch)
+    except _PhaseDeadlineExpired as e:
+        print(json.dumps({**partial, "degraded": str(e)}))
+        return
+    except Exception as e:                      # noqa: BLE001 — classify below
+        if _backend_unavailable(e):
+            print(json.dumps({**partial,
+                              "skipped": f"TPU backend unavailable: "
+                                         f"{str(e)[:300]}"}))
+            return
+        raise
     flagship_quality = dict(_diff_time_quality)   # before later diff_time calls
     if args.model == "gat":
         args.skip_torch = True          # yardsticks below are GCN-shaped
@@ -619,19 +902,32 @@ def main() -> None:
     # speedup with gather efficiency; emit null there.
     import jax as _jax
     single = len(_jax.devices()) == 1 and args.model == "gcn"
-    dense_s = bench_dense_equiv(args.n, args.f, widths, args.epochs) \
-        if single else None
+    try:
+        dense_s = bench_dense_equiv(args.n, args.f, widths, args.epochs) \
+            if single else None
+    except Exception as e:                      # noqa: BLE001 — yardstick only
+        print(f"# dense yardstick failed: {e!r}", file=sys.stderr)
+        dense_s = None
     if args.skip_torch:
         vs = None                               # never fabricate parity
     else:
-        ref_s = bench_torch_reference(ahat, feats, labels, widths,
-                                      max(2, args.epochs // 2))
-        vs = round(ref_s / epoch_s, 3)
+        try:
+            ref_s = bench_torch_reference(ahat, feats, labels, widths,
+                                          max(2, args.epochs // 2))
+            vs = round(ref_s / epoch_s, 3)
+        except Exception as e:                  # noqa: BLE001 — yardstick only
+            print(f"# torch yardstick failed: {e!r}", file=sys.stderr)
+            vs = None
     vdev_metrics = {}
     if not (args.skip_vdev or args.vdev_child):
         vdev_metrics = bench_vdev_partitioned(
             args.vdev_n, args.avg_deg, args.f, widths, max(2, args.epochs // 2),
             graph=args.vdev_graph)
+        if (args.model == "gcn" and args.halo_staleness == 0
+                and not args.skip_stale_ab):
+            vdev_metrics.update(bench_stale_ab(
+                args.stale_ab_n, args.avg_deg, args.f, widths,
+                max(2, args.epochs // 2), graph=args.vdev_graph))
     extra = {}
     if not args.vdev_child:
         extra.update(products_partition_block())

@@ -595,6 +595,7 @@ def gat_forward_local(
                                   # PATTERN (attention VALUES need not be)
     cell_buckets: tuple | None = None,   # static plan.cell_buckets
     axis_name: str = AXIS,
+    halo_carry=None,              # stale-halo carries (trainer contract slot)
 ):
     """Per-chip forward: stacked GAT layers.
 
@@ -606,7 +607,18 @@ def gat_forward_local(
     split overlap form): the edge-softmax normalizes each row over local AND
     halo edges together, so the aggregation genuinely depends on the
     exchange.
+
+    ``halo_carry`` is the trainer's stale-halo carry slot (the pipelined
+    exchange of ``ops.pspmm.pspmm_stale``).  GAT's exchange ships per-layer
+    attention tables ``[Z_j, z2_j]`` whose staleness interacts with the
+    edge-softmax normalization — carrying them is future work, so only the
+    exact mode (``halo_carry=None``) is accepted here; the trainer gates
+    ``halo_staleness`` to the GCN model accordingly.
     """
+    if halo_carry is not None:
+        raise NotImplementedError(
+            "stale-halo pipelining is implemented for the GCN hot path only; "
+            "run GAT with halo_staleness=0")
     if cell_buckets is None:
         raise ValueError("GAT forward needs the plan's static cell_buckets")
     act = get_activation(activation)

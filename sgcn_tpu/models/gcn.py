@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.pspmm import pspmm_ell_sym, pspmm_overlap
+from ..ops.pspmm import pspmm_ell_sym, pspmm_overlap, pspmm_stale
 from ..parallel.mesh import AXIS
 from .activations import get_activation
 
@@ -146,6 +146,60 @@ def gcn_forward_local(
             z = agg(h) @ w
         h = fact(z) if i == nl - 1 else act(z)
     return h
+
+
+def gcn_forward_local_stale(
+    params,
+    h,                      # (B, f_in) local feature rows
+    pa,                     # plan arrays dict (GCN_PLAN_FIELDS_SYM)
+    halos,                  # per-layer (R, f_ℓ) halo carries (step t−1)
+    ghalos,                 # per-layer (R, f_ℓ) gradient-halo carries
+    bases,                  # per-layer (k, S, f_ℓ) delta baselines (or dummies)
+    activation: str = "relu",
+    final_activation: str = "none",
+    ell_buckets: tuple | None = None,
+    delta: bool = False,            # static: halo-delta caching on the wire
+    wire_dtype: str | None = None,  # static: feature-wire dtype
+    gwire_dtype: str | None = None,  # static: gradient-wire dtype
+    fresh: bool = False,            # static: full-sync step (exact math)
+    axis_name: str = AXIS,
+):
+    """Per-chip forward under the pipelined stale-halo exchange.
+
+    Same layer math and project-first scheduling as ``gcn_forward_local``,
+    but every aggregation goes through ``ops.pspmm.pspmm_stale``: layer ℓ
+    consumes ``halos[ℓ]`` (exchanged during step t−1) and issues step t's
+    exchange with no in-step consumer.  Returns
+    ``(out, new_halos, new_bases)``; the gradient-halo carries come back as
+    the ``ghalos`` cotangents of ``jax.value_and_grad`` (see
+    ``pspmm_stale``).  Symmetric-Â plans only — the trainer gates on
+    ``plan.symmetric``.
+    """
+    if ell_buckets is None:
+        raise ValueError(
+            "stale GCN forward needs the plan's static ell_buckets")
+    act = get_activation(activation)
+    fact = get_activation(final_activation)
+    nl = len(params)
+    new_halos, new_bases = [], []
+    for i, w in enumerate(params):
+        # identical scheduling rule to gcn_forward_local: the carry widths
+        # (plan.stale_carry_shapes → exchange_widths) encode the same rule
+        project_first = (w.shape[1] < h.shape[1]
+                         and h.shape[1] >= PROJECT_FIRST_MIN_FIN)
+        x = (h @ w) if project_first else h
+        z, hn, bn = pspmm_stale(
+            x, halos[i], ghalos[i], bases[i],
+            pa["send_idx"], pa["halo_src"], pa["ell_idx"], pa["ell_w"],
+            pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
+            pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
+            ell_buckets, axis_name, delta, wire_dtype, gwire_dtype, fresh)
+        if not project_first:
+            z = z @ w
+        new_halos.append(hn)
+        new_bases.append(bn)
+        h = fact(z) if i == nl - 1 else act(z)
+    return h, new_halos, new_bases
 
 
 def masked_softmax_xent_local(logits, labels, valid, axis_name: str = AXIS):

@@ -322,3 +322,119 @@ def _pspmm_ell_sym_bwd(buckets, axis_name, halo_dtype, res, g):
 
 
 pspmm_ell_sym.defvjp(_pspmm_ell_sym_fwd, _pspmm_ell_sym_bwd)
+
+
+# --------------------------------------------------------------------- stale
+# Pipelined one-step-stale exchange (PipeGCN-style, arXiv:2203.10428): layer ℓ
+# of step t aggregates with the halo received during step t−1, and step t's
+# exchange is issued with NO consumer inside the step — XLA is free to
+# schedule the all_to_all entirely behind local SpMM + dense compute, turning
+# the per-layer exchange barrier into a background transfer.  The backward
+# mirrors it: the gradient halo consumed at step t was exchanged at step t−1
+# (bounded-staleness features AND gradients, the combination PipeGCN shows
+# converges at no accuracy loss).  Symmetric-Â only, like ``pspmm_ell_sym``.
+
+
+def _stale_exchange(x, halo_in, base_in, send_idx, halo_src, axis_name,
+                    delta, wire_dtype, fresh):
+    """Issue step t's halo exchange; return ``(halo_next, base_next)``.
+
+    ``delta`` (CaPGNN-style halo-delta caching, arXiv:2508.13716): the wire
+    carries ``x_t − base`` per boundary row, quantized to ``wire_dtype``
+    (bf16 — half the a2a bytes), and BOTH ends accumulate the identical
+    quantized increment — the sender into ``base`` (its model of what every
+    receiver holds), the receiver into its cached halo — so the two stay in
+    exact lockstep and quantization error never compounds into disagreement.
+    A ``fresh`` step re-bases (sends the full value against a zero base),
+    bounding accumulated rounding drift to one bf16 rounding of the row.
+    """
+    full = jnp.take(x, send_idx, axis=0)                     # (k, S, f)
+    if delta:
+        wdt = jnp.bfloat16 if wire_dtype is None else jnp.dtype(wire_dtype)
+        base = jnp.zeros_like(full) if fresh else base_in
+        wire = (full - base).astype(wdt)
+        recv = a2a_or_identity(wire, axis_name)
+        flat = recv.reshape(-1, x.shape[-1]).astype(x.dtype)
+        inc = jnp.take(flat, halo_src, axis=0)
+        prev = jnp.zeros_like(inc) if fresh else halo_in
+        return prev + inc, base + wire.astype(base.dtype)
+    halo_next = halo_exchange(x, send_idx, halo_src, axis_name, wire_dtype)
+    return halo_next, base_in
+
+
+def _pspmm_stale_once(x, halo_in, base_in, send_idx, halo_src, ell_idx, ell_w,
+                      ltail_dst, ltail_src, ltail_w,
+                      hedge_dst, hedge_src, hedge_w,
+                      buckets, axis_name, delta, wire_dtype, fresh):
+    halo_next, base_next = _stale_exchange(
+        x, halo_in, base_in, send_idx, halo_src, axis_name, delta,
+        wire_dtype, fresh)
+    # stale step: the remote term reads the CARRY — nothing in this step
+    # depends on the exchange just issued, so it runs behind the compute;
+    # fresh (sync) step: the remote term waits for the exchange, exactly
+    # the exact-mode dependence structure
+    halo_used = halo_next if fresh else halo_in
+    local = spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, x, buckets)
+    remote = spmm_local(hedge_dst, hedge_src, hedge_w, halo_used, x.shape[0])
+    return local + remote, halo_next, base_next
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(14, 15, 16, 17, 18, 19))
+def pspmm_stale(x, halo_in, ghalo_in, base_in, send_idx, halo_src,
+                ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+                hedge_dst, hedge_src, hedge_w, buckets,
+                axis_name=AXIS, delta=False, wire_dtype=None,
+                gwire_dtype=None, fresh=False):
+    """``PSpMM`` with a one-step-stale halo carry — the pipelined contract.
+
+    Forward: ``out = Â_local·x + Â_halo·halo_in`` (the carry, exchanged last
+    step) and step t's exchange is issued into ``halo_next`` with no
+    in-step consumer.  Backward (symmetric Â): ``g_x = Â_local·g +
+    Â_halo·ghalo_in`` — the stale GRADIENT halo — and the fresh gradient
+    exchange ``halo_exchange(g)`` is emitted as the cotangent of the
+    ``ghalo_in`` argument.  That channel is deliberate plumbing, not a real
+    derivative: differentiate the caller w.r.t. its ``ghalo`` carry
+    (``jax.value_and_grad(..., argnums=(params, ghalos))``) and the "grad"
+    that comes back IS next step's gradient-halo carry.  ``fresh=True``
+    compiles the periodic full-sync step: both halos are consumed fresh
+    (exact-mode math) and the carries are refreshed as a byproduct.
+
+    Returns ``(out, halo_next, base_next)``; the carries are aux outputs
+    (their cotangents are ignored — they cross the step boundary, which
+    per-step autodiff never differentiates through).
+    """
+    return _pspmm_stale_once(
+        x, halo_in, base_in, send_idx, halo_src, ell_idx, ell_w,
+        ltail_dst, ltail_src, ltail_w, hedge_dst, hedge_src, hedge_w,
+        buckets, axis_name, delta, wire_dtype, fresh)
+
+
+def _pspmm_stale_fwd(x, halo_in, ghalo_in, base_in, send_idx, halo_src,
+                     ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+                     hedge_dst, hedge_src, hedge_w, buckets,
+                     axis_name, delta, wire_dtype, gwire_dtype, fresh):
+    out = _pspmm_stale_once(
+        x, halo_in, base_in, send_idx, halo_src, ell_idx, ell_w,
+        ltail_dst, ltail_src, ltail_w, hedge_dst, hedge_src, hedge_w,
+        buckets, axis_name, delta, wire_dtype, fresh)
+    res = (ghalo_in, send_idx, halo_src, ell_idx, ell_w,
+           ltail_dst, ltail_src, ltail_w, hedge_dst, hedge_src, hedge_w)
+    return out, res
+
+
+def _pspmm_stale_bwd(buckets, axis_name, delta, wire_dtype, gwire_dtype,
+                     fresh, res, cts):
+    (ghalo_in, send_idx, halo_src, ell_idx, ell_w,
+     ltail_dst, ltail_src, ltail_w, hedge_dst, hedge_src, hedge_w) = res
+    g, _, _ = cts            # carry cotangents are structurally zero
+    # issue step t's gradient exchange; like the forward's, it has no
+    # consumer in the stale step (g_x reads the CARRY), so it too rides
+    # behind compute.  It leaves through the ghalo_in cotangent channel.
+    gh_next = halo_exchange(g, send_idx, halo_src, axis_name, gwire_dtype)
+    gh_used = gh_next if fresh else ghalo_in
+    gx = (spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, g, buckets)
+          + spmm_local(hedge_dst, hedge_src, hedge_w, gh_used, g.shape[0]))
+    return (gx, None, gh_next, None, *[None] * 10)
+
+
+pspmm_stale.defvjp(_pspmm_stale_fwd, _pspmm_stale_bwd)

@@ -38,6 +38,31 @@ from dataclasses import dataclass
 import numpy as np
 import scipy.sparse as sp
 
+# Every CommPlan array field stacked per chip along a leading ``k`` axis —
+# THE explicit classification anything slicing a plan per chip must use
+# (``parallel/proxy.py::shard_proxy_plan``), instead of inferring per-chip-ness
+# from a ``shape[0] == plan.k`` coincidence (round-5 advisor finding: a
+# global-vertex field of an n==k graph, or a future (k_something, ...) field,
+# would silently mis-slice).  Optional fields (the lazy cell/pallas layouts)
+# are listed too and skipped while ``None``.  Fields NOT here and not in
+# ``_GLOBAL_ARRAY_FIELDS`` must never carry a leading per-chip axis — the
+# proxy enforces that loudly.
+PER_CHIP_ARRAY_FIELDS = (
+    "part_sizes",
+    "send_idx", "send_counts", "halo_src", "halo_counts",
+    "edge_dst", "edge_src", "edge_w", "nnz", "row_valid",
+    "ledge_dst", "ledge_src", "ledge_w",
+    "hedge_dst", "hedge_src", "hedge_w", "lnnz", "hnnz",
+    "ell_idx", "ell_w", "ltail_dst", "ltail_src", "ltail_w", "ltail_nnz",
+    "cell_idx", "cell_w", "ctail_dst", "ctail_src", "ctail_w", "ctail_nnz",
+    "ptile_lsrc", "ptile_lld", "ptile_lw",
+    "ptile_hsrc", "ptile_hld", "ptile_hw",
+)
+
+# Global-vertex-indexed arrays (plus the proxy's chip-identity record):
+# pass through a per-chip slice untouched.
+_GLOBAL_ARRAY_FIELDS = ("owner", "local_idx", "chip_ids")
+
 
 @dataclass
 class CommPlan:
@@ -215,6 +240,32 @@ class CommPlan:
             for name, val in fields.items():
                 setattr(self, name, val)
         return self
+
+    # ------------------------------------------------------------ stale halo
+    def stale_carry_shapes(self, fin: int, widths, delta: bool = False) -> dict:
+        """Per-layer carry shapes (WITHOUT the stacked leading k axis) for
+        the pipelined stale-halo mode (``ops.pspmm.pspmm_stale``).
+
+        ``halos[ℓ]`` / ``ghalos[ℓ]``: the ``(R, f_ℓ)`` feature- and
+        gradient-halo buffers carried across steps, where ``f_ℓ`` is the
+        layer's EXCHANGED row width under the trainer's project-first rule
+        (``models.gcn.exchange_widths`` — the single shared encoding of that
+        rule, so the carries stay in lockstep with the forward's schedule).
+        ``bases[ℓ]``: the sender-side ``(k, S, f_ℓ)`` delta baseline when
+        ``delta`` (the halo-delta cache), else a ``(1, 1, 1)`` placeholder
+        so the carry pytree keeps one static structure per mode.
+        """
+        from ..models.gcn import exchange_widths   # deferred: avoids a cycle
+
+        fs = exchange_widths(fin, list(widths))
+        peers = self.send_idx.shape[1]   # == k on a full plan; kept explicit
+                                         # so a shard-proxy slice stays right
+        return {
+            "halos": [(self.r, f) for f in fs],
+            "ghalos": [(self.r, f) for f in fs],
+            "bases": [((peers, self.s, f) if delta else (1, 1, 1))
+                      for f in fs],
+        }
 
     # ------------------------------------------------------------------ stats
     def offwire_send_counts(self) -> np.ndarray:

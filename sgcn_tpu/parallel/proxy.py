@@ -34,30 +34,51 @@ import dataclasses
 
 import numpy as np
 
-from .plan import CommPlan
+from .plan import _GLOBAL_ARRAY_FIELDS, PER_CHIP_ARRAY_FIELDS, CommPlan
 
 
 def shard_proxy_plan(plan: CommPlan, chip: int = 0) -> CommPlan:
     """A ``k=1`` view of ``plan`` carrying only chip ``chip``'s arrays.
 
-    Every dataclass field that is a stacked per-chip array (leading axis
-    ``plan.k``) is sliced to ``[chip:chip+1]``; global-vertex arrays
-    (``owner``, ``local_idx``) and scalars pass through.  The result trains
-    on a 1-device mesh with the chip's exact padded shapes: ``send_idx``
-    stays ``(1, k, S)`` (per-chip view ``(k, S)``), so the send buffer and
-    the ``(k*S, f)`` receive window are full-size.
+    Slicing is driven by the plan's EXPLICIT per-chip field classification
+    (``plan.PER_CHIP_ARRAY_FIELDS``): each listed field is verified to carry
+    the stacked leading ``k`` axis and sliced to ``[chip:chip+1]``;
+    global-vertex arrays (``owner``, ``local_idx``) and scalars pass
+    through.  Any UNclassified dataclass field that happens to look
+    per-chip-stacked fails loudly instead of being silently sliced (or
+    silently passed through whole) — the old ``shape[0] == plan.k``
+    inference mis-slices exactly those cases (round-5 advisor finding).
+
+    The result trains on a 1-device mesh with the chip's exact padded
+    shapes: ``send_idx`` stays ``(1, k, S)`` (per-chip view ``(k, S)``), so
+    the send buffer and the ``(k*S, f)`` receive window are full-size.
     """
     if not 0 <= chip < plan.k:
         raise ValueError(f"chip {chip} out of range for k={plan.k}")
     # record the true chip identity: sliced send_counts row 0 self-sends at
     # column `chip`, which the comm-stat properties must zero (not [0, 0])
     repl: dict = {"k": 1, "chip_ids": np.array([chip])}
+    for name in PER_CHIP_ARRAY_FIELDS:
+        v = getattr(plan, name)
+        if v is None:              # lazy layout (cell/pallas) not built
+            continue
+        if not (isinstance(v, np.ndarray) and v.ndim >= 1
+                and v.shape[0] == plan.k):
+            raise ValueError(
+                f"CommPlan.{name} is classified per-chip-stacked but has "
+                f"shape {getattr(v, 'shape', None)} (k={plan.k}) — "
+                "PER_CHIP_ARRAY_FIELDS is out of sync with the dataclass")
+        repl[name] = v[chip: chip + 1]
     for fld in dataclasses.fields(plan):
+        if fld.name in PER_CHIP_ARRAY_FIELDS or fld.name in _GLOBAL_ARRAY_FIELDS:
+            continue
         v = getattr(plan, fld.name)
-        if (isinstance(v, np.ndarray) and v.ndim >= 1
-                and v.shape[0] == plan.k
-                and fld.name not in ("owner", "local_idx")):
-            repl[fld.name] = v[chip: chip + 1]
+        if isinstance(v, np.ndarray) and v.ndim >= 1 and v.shape[0] == plan.k:
+            raise ValueError(
+                f"CommPlan.{fld.name} looks per-chip-stacked (leading axis "
+                f"{plan.k}) but is not classified in PER_CHIP_ARRAY_FIELDS — "
+                "add it there (sliced) or to _GLOBAL_ARRAY_FIELDS "
+                "(passed through) before proxying")
     return dataclasses.replace(plan, **repl)
 
 

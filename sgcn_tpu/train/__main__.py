@@ -56,6 +56,21 @@ def main() -> None:
     p.add_argument("--halo-dtype", default=None, choices=["bfloat16"],
                    help="wire-only exchange dtype: halves a2a ICI bytes, "
                         "all compute stays f32 (full-batch GCN only)")
+    p.add_argument("--halo-staleness", type=int, default=0, choices=[0, 1],
+                   help="0 (default) = exact per-layer halo exchange; 1 = "
+                        "pipelined one-step-stale exchange: layer L of step "
+                        "t aggregates with the halo exchanged during step "
+                        "t-1, so the a2a leaves the critical path "
+                        "(full-batch GCN, symmetric adjacency only; see "
+                        "docs/stale_halo.md)")
+    p.add_argument("--halo-delta", action="store_true",
+                   help="halo-delta cache on top of --halo-staleness 1: "
+                        "boundary rows ship as bf16 deltas accumulated "
+                        "into the carried remote halo (half the wire bytes)")
+    p.add_argument("--sync-every", type=int, default=0,
+                   help="stale mode: run a full-sync (exact-math) step "
+                        "every N steps to bound staleness/quantization "
+                        "drift; 0 = only the initializing first step")
     p.add_argument("--epochs", type=int, default=4)
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--lr", type=float, default=0.01)
@@ -103,6 +118,20 @@ def main() -> None:
             "accuracy-parity harness is defined for the f32-wire config; "
             "under --dtype bfloat16 the wire is already bf16, so the flag "
             "would be a silent no-op)")
+    if args.halo_staleness and (args.batch_size is not None
+                                or args.model != "gcn"
+                                or args.experiment == "accuracy"
+                                or args.dtype):
+        raise SystemExit(
+            "--halo-staleness 1 pipelines the full-batch GCN trainer only "
+            "(the mini-batch sweep re-plans per batch, GAT ships per-layer "
+            "attention tables, the accuracy-parity harness is defined for "
+            "the exact exchange, and the carries are f32 state — drop the "
+            "conflicting flag)")
+    if (args.halo_delta or args.sync_every) and not args.halo_staleness:
+        raise SystemExit(
+            "--halo-delta/--sync-every configure the stale pipelined "
+            "exchange; add --halo-staleness 1")
 
     from ..utils.backend import enable_tpu_async_collectives, use_cpu_devices
     if args.backend == "cpu":
@@ -221,7 +250,10 @@ def main() -> None:
                                   model=args.model, loss=args.loss,
                                   activation=activation, seed=args.seed,
                                   compute_dtype=args.dtype,
-                                  halo_dtype=args.halo_dtype)
+                                  halo_dtype=args.halo_dtype,
+                                  halo_staleness=args.halo_staleness,
+                                  halo_delta=args.halo_delta,
+                                  sync_every=args.sync_every)
             state = tr
             start_step = 0
             if args.resume:

@@ -166,6 +166,12 @@ def _unblock(tree):
     return jax.tree.map(lambda x: x[0], tree)
 
 
+def _reblock(tree):
+    """Re-add the leading per-chip block axis for ``out_specs=P(AXIS)``
+    outputs (the stacked-carry convention, like ``logits[None]`` in eval)."""
+    return jax.tree.map(lambda x: x[None], tree)
+
+
 class FullBatchTrainer:
     """Distributed full-batch trainer (PGCN-equivalent, ``-b jax`` backend)."""
 
@@ -185,6 +191,9 @@ class FullBatchTrainer:
         compute_dtype: str | None = None,
         remat: bool = False,
         halo_dtype: str | None = None,
+        halo_staleness: int = 0,
+        halo_delta: bool = False,
+        sync_every: int = 0,
     ):
         """``compute_dtype='bfloat16'`` runs forward/backward (including the
         halo exchange — half the ICI bytes) in bf16 with f32 master params
@@ -205,12 +214,62 @@ class FullBatchTrainer:
         activations are recomputed in the backward pass instead of stored —
         the HBM-for-FLOPs trade for deep stacks / huge vertex counts (no
         reference analogue; the MPI code stores every layer's H and Z,
-        ``Parallel-GCN/main.c:553-607``)."""
+        ``Parallel-GCN/main.c:553-607``).
+
+        ``halo_staleness=1`` selects the PIPELINED exchange (the
+        PipeGCN-style bounded-staleness mode, ``ops/pspmm.py::pspmm_stale``):
+        each chip carries per-layer halo buffers across steps, layer ℓ of
+        step t aggregates with the halo exchanged during step t−1, and step
+        t's exchange (features forward, gradients backward) has no same-step
+        consumer — XLA schedules the a2a entirely behind local compute, so
+        the only collective on the critical path disappears from it.  Step 0
+        and, with ``sync_every=N``, every N-th step run the FULL-SYNC
+        program (fresh halos consumed — exact math) to initialize/bound the
+        carries' drift.  ``halo_delta=True`` adds the halo-delta cache on
+        the feature wire: boundary rows ship as ``h_t − h_{t−1}`` in bf16
+        and both ends accumulate the identical quantized increment, halving
+        wire bytes (the gradient wire stays at ``halo_dtype``).  ``0``
+        (default) is EXACTLY the pre-existing trainer — same code path, same
+        program.  GCN + symmetric Â only; evaluation always runs the exact
+        forward."""
         if halo_dtype is not None and model != "gcn":
             raise ValueError(
                 "halo_dtype is a GCN-trainer lever; for GAT use "
                 "compute_dtype='bfloat16' (the packed exchange already "
                 "ships half-width rows)")
+        if halo_staleness not in (0, 1):
+            raise ValueError(
+                f"halo_staleness must be 0 (exact) or 1 (pipelined), got "
+                f"{halo_staleness}")
+        if halo_delta and not halo_staleness:
+            raise ValueError(
+                "halo_delta accumulates into the stale halo carry; it "
+                "requires halo_staleness=1")
+        if sync_every < 0:
+            raise ValueError(f"sync_every must be >= 0, got {sync_every}")
+        if sync_every and not halo_staleness:
+            raise ValueError(
+                "sync_every schedules the stale mode's full-sync steps; it "
+                "requires halo_staleness=1 (exact mode is always in sync)")
+        if halo_staleness:
+            if model != "gcn":
+                raise ValueError(
+                    "halo_staleness=1 pipelines the GCN hot path; the GAT "
+                    "exchange ships per-layer attention tables whose "
+                    "staleness is not supported (models/gat.py)")
+            if not plan.symmetric:
+                raise ValueError(
+                    "halo_staleness=1 uses the symmetric-Â custom backward "
+                    "(stale gradient exchange == stale forward exchange "
+                    "pattern); this plan is asymmetric — run exact mode")
+            if compute_dtype is not None or remat:
+                raise ValueError(
+                    "halo_staleness=1 is defined for the f32 non-remat "
+                    "trainer (carries are f32 state threaded through the "
+                    "step); drop compute_dtype/remat or run exact mode")
+        self.halo_staleness = halo_staleness
+        self.halo_delta = halo_delta
+        self.sync_every = sync_every
         self.halo_dtype = halo_dtype
         self.plan = plan
         self.mesh = mesh if mesh is not None else make_mesh_1d(plan.k)
@@ -221,9 +280,12 @@ class FullBatchTrainer:
         init_fn, self._forward_fn, fields_fn, static_fn = MODELS[model]
         self.plan_fields = fields_fn(plan)
         self._fwd_static = static_fn(plan)   # e.g. the ELL bucket structure
-        if model == "gcn":
+        if model == "gcn" and not halo_staleness:
             # plan-driven kernel choice (VERDICT r3 #9): per-chip tables in
-            # the VMEM regime switch the aggregator to the Pallas kernel
+            # the VMEM regime switch the aggregator to the Pallas kernel.
+            # The stale mode stays on the ELL aggregator: pspmm_stale's
+            # carry contract is built around it, and hiding the exchange
+            # removes the latency the VMEM kernel would have overlapped.
             from ..ops.pallas_spmm import (PALLAS_PLAN_FIELDS,
                                            use_pallas_spmm)
             if use_pallas_spmm(plan, fin, widths):
@@ -271,6 +333,21 @@ class FullBatchTrainer:
         self._step = self._build_step()
         self._eval = self._build_eval()
         self._multi = {}        # epochs -> compiled on-device epoch loop
+        if halo_staleness:
+            # per-layer carry state, stacked per chip and sharded like the
+            # plan arrays; zeros are never consumed — the first step (and
+            # every sync step) runs the full-sync program, which reads the
+            # FRESH exchange and refreshes every carry as a byproduct
+            shapes = plan.stale_carry_shapes(fin, widths, delta=halo_delta)
+            carry = {
+                name: [np.zeros((plan.k,) + s, np.float32) for s in shps]
+                for name, shps in shapes.items()
+            }
+            self.halo_carry = shard_stacked(self.mesh, carry)
+            self._stale_step_idx = 0
+            self._step_stale = self._build_step_stale(fresh=False)
+            self._step_sync = self._build_step_stale(fresh=True)
+            self._multi_stale = {}   # epochs -> compiled stale epoch loop
 
     # ------------------------------------------------------------------ build
     def _forward(self, params, pa, h0):
@@ -312,6 +389,118 @@ class FullBatchTrainer:
         updates, opt_state = self.opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss, err
+
+    # ------------------------------------------------------- stale pipelining
+    def _forward_stale(self, params, pa, h0, halos, ghalos, bases,
+                       fresh: bool):
+        from ..models.gcn import gcn_forward_local_stale
+
+        out, nh, nb = gcn_forward_local_stale(
+            params, h0, pa, halos, ghalos, bases,
+            activation=self.activation,
+            final_activation=self.final_activation,
+            ell_buckets=self._fwd_static["ell_buckets"],
+            delta=self.halo_delta,
+            # the delta cache IS the bf16 wire; otherwise the stale feature
+            # wire keeps the exact mode's halo_dtype semantics
+            wire_dtype="bfloat16" if self.halo_delta else self.halo_dtype,
+            gwire_dtype=self.halo_dtype,
+            fresh=fresh,
+        )
+        return out.astype("float32"), nh, nb
+
+    def _one_step_stale(self, params, opt_state, carry, pa, h0, labels,
+                        valid, fresh: bool):
+        """One per-chip training step under the pipelined stale exchange.
+
+        The gradient-halo carries ride jax's cotangent machinery: the loss
+        is differentiated w.r.t. ``(params, ghalos)`` and ``pspmm_stale``'s
+        custom VJP returns, as the "gradient" of each ``ghalos[ℓ]``, the
+        FRESH gradient exchange that becomes next step's carry.
+        """
+        halos, ghalos, bases = carry["halos"], carry["ghalos"], carry["bases"]
+
+        def loss_fn(ps, gh):
+            logits, nh, nb = self._forward_stale(
+                ps, pa, h0, halos, gh, bases, fresh)
+            loss = self._loss_fn(logits, labels, valid)
+            err = (masked_err_local(logits, labels, valid)
+                   if self.loss_name == "bce" else loss)
+            return loss, (err, nh, nb)
+
+        (loss, (err, nh, nb)), (grads, ngh) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(params, ghalos)
+        # weight grads are global partial sums (exact mode's psum); the halo
+        # carries are PER-CHIP state — never reduced
+        grads = jax.tree.map(lambda g: lax.psum(g, AXIS), grads)
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        carry = {"halos": nh, "ghalos": list(ngh), "bases": nb}
+        return params, opt_state, carry, loss, err
+
+    def _build_step_stale(self, fresh: bool):
+        def per_chip(params, opt_state, carry, pa, h0, labels, valid):
+            carry, pa, h0, labels, valid = _unblock(
+                (carry, pa, h0, labels, valid))
+            params, opt_state, carry, loss, err = self._one_step_stale(
+                params, opt_state, carry, pa, h0, labels, valid, fresh)
+            return params, opt_state, _reblock(carry), loss, err
+
+        smapped = jax.shard_map(
+            per_chip,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+            out_specs=(P(), P(), P(AXIS), P(), P()),
+        )
+        return jax.jit(smapped, donate_argnums=(0, 1, 2))
+
+    def _build_multi_stale(self, epochs: int):
+        """``epochs`` STALE steps as one on-device fori_loop (the carry
+        threads through the loop body; sync steps are scheduled around the
+        loop by ``run_epochs``).  ``z`` enters replicated for the same
+        check_rep reason as ``_build_multi``."""
+        def per_chip(params, opt_state, carry, pa, h0, labels, valid, z):
+            carry, pa, h0, labels, valid = _unblock(
+                (carry, pa, h0, labels, valid))
+
+            def body(i, st):
+                params, opt_state, carry, losses, errs = st
+                params, opt_state, carry, loss, err = self._one_step_stale(
+                    params, opt_state, carry, pa, h0, labels, valid, False)
+                return (params, opt_state, carry, losses.at[i].set(loss),
+                        errs.at[i].set(err))
+
+            params, opt_state, carry, losses, errs = lax.fori_loop(
+                0, epochs, body, (params, opt_state, carry, z, z))
+            return params, opt_state, _reblock(carry), losses, errs
+
+        smapped = jax.shard_map(
+            per_chip,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                      P()),
+            out_specs=(P(), P(), P(AXIS), P(), P()),
+        )
+        return jax.jit(smapped, donate_argnums=(0, 1, 2))
+
+    def _stale_sync_due(self) -> bool:
+        """Carry init (step 0) + the periodic full-sync schedule."""
+        if self._stale_step_idx == 0:
+            return True
+        return bool(self.sync_every) and \
+            self._stale_step_idx % self.sync_every == 0
+
+    def _stale_run_one(self, data: TrainData):
+        """One stale-mode optimizer step (sync or pipelined per schedule)."""
+        sync_step = self._stale_sync_due()
+        prog = self._step_sync if sync_step else self._step_stale
+        (self.params, self.opt_state, self.halo_carry, loss, err) = prog(
+            self.params, self.opt_state, self.halo_carry, self.pa,
+            data.h0, data.labels, data.train_valid,
+        )
+        self._stale_step_idx += 1
+        self.stats.count_step(nlayers=self.nlayers, hidden=not sync_step)
+        return loss, err
 
     def _build_step(self, mesh=None):
         def per_chip(params, opt_state, pa, h0, labels, valid):
@@ -367,10 +556,16 @@ class FullBatchTrainer:
         are identical to `epochs` sequential ``step()`` calls; per-epoch
         losses come back as an array (the reference's per-epoch loss print,
         ``GPU/PGCN.py:223-224``, reads them after the run).
-        """
-        import jax.numpy as jnp
 
-        def per_chip(params, opt_state, pa, h0, labels, valid):
+        The per-epoch loss/err accumulators enter as a REPLICATED argument
+        (``z``) rather than an in-body ``jnp.zeros`` literal: the loop carry
+        must hold one replication type throughout, and a literal's type is
+        untracked while the psum'd losses written into it are replicated —
+        shard_map's check_rep rejects that pairing (observed on jaxlib
+        0.4.37; an argument with ``P()`` spec is tracked replicated from the
+        start).  Same math either way.
+        """
+        def per_chip(params, opt_state, pa, h0, labels, valid, z):
             pa, h0, labels, valid = _unblock((pa, h0, labels, valid))
 
             def body(i, carry):
@@ -380,7 +575,6 @@ class FullBatchTrainer:
                 return (params, opt_state, losses.at[i].set(loss),
                         errs.at[i].set(err))
 
-            z = jnp.zeros((epochs,), jnp.float32)
             params, opt_state, losses, errs = lax.fori_loop(
                 0, epochs, body, (params, opt_state, z, z))
             return params, opt_state, losses, errs
@@ -388,7 +582,7 @@ class FullBatchTrainer:
         smapped = jax.shard_map(
             per_chip,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+            in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
             out_specs=(P(), P(), P(), P()),
         )
         return jax.jit(smapped, donate_argnums=(0, 1))
@@ -396,16 +590,59 @@ class FullBatchTrainer:
     def run_epochs(self, data: TrainData, epochs: int, sync: bool = True):
         """Run ``epochs`` steps in one device program; return per-epoch losses.
 
-        ``sync=False`` returns the on-device loss array without blocking."""
+        ``sync=False`` returns the on-device loss array without blocking.
+
+        Stale mode runs the same on-device loop over PIPELINED steps, with
+        the full-sync steps (carry init + every ``sync_every``-th step)
+        dispatched individually around the loop segments."""
+        if self.halo_staleness:
+            return self._run_epochs_stale(data, epochs, sync)
         if epochs not in self._multi:
             self._multi[epochs] = self._build_multi(epochs)
         self.params, self.opt_state, losses, errs = self._multi[epochs](
             self.params, self.opt_state, self.pa, data.h0, data.labels,
-            data.train_valid,
+            data.train_valid, np.zeros((epochs,), np.float32),
         )
         self.last_err = errs[-1]        # keep step()'s scalar contract
         for _ in range(epochs):
             self.stats.count_step(nlayers=self.nlayers)
+        return np.asarray(losses) if sync else losses
+
+    def _run_epochs_stale(self, data: TrainData, epochs: int, sync: bool):
+        import jax.numpy as jnp
+
+        parts, err_parts = [], []
+        left = epochs
+        while left > 0:
+            if self._stale_sync_due():
+                loss, err = self._stale_run_one(data)
+                parts.append(jnp.reshape(loss, (1,)))
+                err_parts.append(jnp.reshape(err, (1,)))
+                left -= 1
+                continue
+            run = left
+            if self.sync_every:
+                until_sync = (self.sync_every
+                              - self._stale_step_idx % self.sync_every)
+                run = min(left, until_sync)
+            if run not in self._multi_stale:
+                self._multi_stale[run] = self._build_multi_stale(run)
+            (self.params, self.opt_state, self.halo_carry, losses,
+             errs) = self._multi_stale[run](
+                self.params, self.opt_state, self.halo_carry, self.pa,
+                data.h0, data.labels, data.train_valid,
+                np.zeros((run,), np.float32),
+            )
+            self._stale_step_idx += run
+            for _ in range(run):
+                self.stats.count_step(nlayers=self.nlayers, hidden=True)
+            parts.append(losses)
+            err_parts.append(errs)
+            left -= run
+        losses = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        errs = (err_parts[0] if len(err_parts) == 1
+                else jnp.concatenate(err_parts))
+        self.last_err = errs[-1]
         return np.asarray(losses) if sync else losses
 
     def _build_eval(self):
@@ -436,6 +673,10 @@ class FullBatchTrainer:
         the on-device loss array so callers can pipeline many steps and pay
         one host round-trip at the end (the tunneled dev chip has ~90 ms
         round-trip latency that would otherwise swamp epoch timings)."""
+        if self.halo_staleness:
+            loss, err = self._stale_run_one(data)
+            self.last_err = err
+            return float(loss) if sync else loss
         self.params, self.opt_state, loss, err = self._step(
             self.params, self.opt_state, self.pa, data.h0, data.labels,
             data.train_valid,

@@ -238,7 +238,13 @@ class MiniBatchTrainer:
         tr = self.inner
         nb = len(self.plans)
 
-        def per_chip(params, opt_state, pa_s, h0, lab, val):
+        # the loss/err accumulators enter as REPLICATED arguments rather
+        # than in-body jnp.zeros literals: a fori carry must keep one
+        # replication type, and a literal's is untracked while the psum'd
+        # losses written into it are replicated — shard_map's check_rep
+        # rejects the pair (observed on jaxlib 0.4.37; same fix as
+        # FullBatchTrainer._build_multi)
+        def per_chip(params, opt_state, pa_s, h0, lab, val, z_ep, z_nb):
             pa_s, h0, lab, val = _unblock((pa_s, h0, lab, val))
 
             def batch_body(i, carry):
@@ -251,17 +257,15 @@ class MiniBatchTrainer:
             def epoch_body(e, carry):
                 params, opt_state, ep_losses, err = carry
                 params, opt_state, s, err = lax.fori_loop(
-                    0, nb, batch_body,
-                    (params, opt_state, jnp.zeros((nb,), jnp.float32), err))
+                    0, nb, batch_body, (params, opt_state, z_nb, err))
                 return params, opt_state, ep_losses.at[e].set(s.mean()), err
 
-            z = jnp.zeros((epochs,), jnp.float32)
             return lax.fori_loop(0, epochs, epoch_body,
-                                 (params, opt_state, z, jnp.float32(0)))
+                                 (params, opt_state, z_ep, z_ep.sum()))
 
         smapped = jax.shard_map(
             per_chip, mesh=self.mesh,
-            in_specs=(P(), P(), P("v"), P("v"), P("v"), P("v")),
+            in_specs=(P(), P(), P("v"), P("v"), P("v"), P("v"), P(), P()),
             out_specs=(P(), P(), P(), P()))
         return jax.jit(smapped, donate_argnums=(0, 1))
 
@@ -292,7 +296,8 @@ class MiniBatchTrainer:
         tr = self.inner
         tr.params, tr.opt_state, losses, tr.last_err = self._fused[epochs](
             tr.params, tr.opt_state, pa_s, data.h0, data.labels,
-            data.train_valid)
+            data.train_valid, np.zeros((epochs,), np.float32),
+            np.zeros((len(self.plans),), np.float32))
         # same 8-number comm accounting as the stepwise path (one counter
         # set per batch plan, merged on report)
         if not hasattr(self, "_fused_stats"):

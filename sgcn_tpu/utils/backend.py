@@ -13,6 +13,26 @@ from __future__ import annotations
 import os
 
 
+# The ONE classification of "the accelerator backend is unavailable" shared
+# by every driver-facing degradation path (bench.py, __graft_entry__.py):
+# matching text means "skip with a marker, rc 0"; anything else is a genuine
+# code failure that must keep propagating.  Keep the markers NARROW — a
+# broad substring (an earlier draft matched bare "initialization") turns
+# real bugs into green skipped runs.
+BACKEND_UNAVAILABLE_MARKERS = (
+    "unable to initialize backend", "failed to initialize", "no devices",
+    "backend unavailable", "deadline_exceeded", "unavailable:",
+    "failed precondition", "failed_precondition", "tpu platform",
+)
+
+
+def looks_backend_unavailable(text: str) -> bool:
+    """True when ``text`` (an exception string or a child's stderr) reads as
+    an accelerator-backend bring-up failure rather than a code bug."""
+    text = (text or "").lower()
+    return any(m in text for m in BACKEND_UNAVAILABLE_MARKERS)
+
+
 def use_cpu_devices(nparts: int) -> None:
     """Force ``nparts`` virtual host CPU devices for this process."""
     flags = os.environ.get("XLA_FLAGS", "")

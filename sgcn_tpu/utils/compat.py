@@ -1,0 +1,48 @@
+"""JAX API compatibility layer.
+
+The codebase is written against the current public jax API (``jax.shard_map``,
+``jax.lax.pcast``).  Some execution containers pin an older jaxlib (observed:
+0.4.37) where the same functionality lives under ``jax.experimental`` or does
+not exist because the subsystem it belongs to (the varying-axes replication
+types) postdates the release.  Importing this module installs the missing
+names once, guarded so a current jax is untouched:
+
+  * ``jax.shard_map``  ← ``jax.experimental.shard_map.shard_map`` (identical
+    call signature for the ``mesh``/``in_specs``/``out_specs`` kwargs every
+    call site uses);
+  * ``jax.lax.axis_size``  ← ``lax.psum(1, axis)``, which constant-folds to
+    the axis size as a Python int (no collective emitted);
+  * ``jax.lax.pcast``  ← identity.  ``pcast(x, axis, to='varying')`` only
+    adjusts the replication TYPE of ``x`` under the new type system; a jax
+    without that system has nothing to adjust, so identity is exact (the
+    ``check_rep`` machinery of the experimental shard_map tracks replication
+    by value instead).
+
+Imported for its side effect by ``sgcn_tpu/__init__`` so every entry point
+(tests, trainers, bench, driver) sees one consistent API.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    jax.shard_map = _shard_map
+
+if not hasattr(lax, "axis_size"):
+    def _axis_size(axis_name):
+        # psum of the Python literal 1 constant-folds to the axis size (a
+        # Python int) in every jax that lacks lax.axis_size — no collective
+        # is emitted, so this is a static-shape-safe drop-in
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = _axis_size
+
+if not hasattr(lax, "pcast"):
+    def _pcast(x, axis_name=None, *, to=None):   # noqa: ARG001 — API shape
+        return x
+
+    lax.pcast = _pcast

@@ -26,6 +26,11 @@ class CommStats:
     recv_volume_per_exchange: np.ndarray   # (k,)
     recv_msgs_per_exchange: np.ndarray     # (k,)
     exchanges: int = 0                     # cumulative halo exchanges performed
+    # Subset of ``exchanges`` issued OFF the critical path (the pipelined
+    # stale-halo mode: the a2a has no same-step consumer, so its latency is
+    # hidden behind local compute).  The volume still crosses the wire —
+    # hence one total and a hidden/exposed split, never two totals.
+    hidden_exchanges: int = 0
 
     @classmethod
     def from_plan(cls, plan) -> "CommStats":
@@ -36,9 +41,16 @@ class CommStats:
             recv_vol, recv_msg = off.sum(axis=0), (off > 0).sum(axis=0)
         else:
             # shard-proxy slice (rows != k): peers' sends are not in view.
-            # Every proxied pattern is symmetric (plan.symmetric), where
-            # per-chip recv == send, so reuse the send side rather than
-            # emit mis-shaped or fabricated recv counters.
+            # Per-chip recv == send holds ONLY for a symmetric exchange
+            # pattern — for anything else the reuse below would FABRICATE
+            # recv counters, so fail loudly instead (round-5 advisor
+            # finding).
+            if not getattr(plan, "symmetric", False):
+                raise ValueError(
+                    "CommStats.from_plan: shard-proxy slice of an ASYMMETRIC "
+                    "plan — peers' sends are out of view and per-chip recv "
+                    "!= send, so recv counters cannot be derived; proxy a "
+                    "symmetric plan or build stats from the full plan")
             recv_vol, recv_msg = send_vol, send_msg
         return cls(
             k=plan.k,
@@ -48,11 +60,14 @@ class CommStats:
             recv_msgs_per_exchange=recv_msg,
         )
 
-    def count_step(self, nlayers: int) -> None:
+    def count_step(self, nlayers: int, hidden: bool = False) -> None:
         """One training step = nlayers forward + nlayers backward exchanges
         (the backward halo exchange mirrors the forward —
-        ``Parallel-GCN/main.c:340-372``)."""
+        ``Parallel-GCN/main.c:340-372``).  ``hidden=True`` marks the step's
+        exchanges as latency-hidden (stale pipelined mode)."""
         self.exchanges += 2 * nlayers
+        if hidden:
+            self.hidden_exchanges += 2 * nlayers
 
     def count_forward(self, nlayers: int) -> None:
         self.exchanges += nlayers
@@ -81,7 +96,23 @@ class CommStats:
         }
 
     def report(self) -> dict:
-        return self.report_from_cumulative(*self.cumulative())
+        """The reference's 8-number line plus the exposed/hidden split:
+        exchanges whose latency sits ON the step's critical path (exposed —
+        every exact-mode exchange) vs exchanges issued with no same-step
+        consumer (hidden — the stale pipelined mode's), with the wire volume
+        attributed to each.  Total keys keep their reference meaning (all
+        bytes cross the wire either way)."""
+        rep = self.report_from_cumulative(*self.cumulative())
+        exposed = self.exchanges - self.hidden_exchanges
+        per_ex = int(self.send_volume_per_exchange.sum())
+        rep.update(
+            exchanges=self.exchanges,
+            exposed_exchanges=exposed,
+            hidden_exchanges=self.hidden_exchanges,
+            exposed_send_volume=per_ex * exposed,
+            hidden_send_volume=per_ex * self.hidden_exchanges,
+        )
+        return rep
 
     @staticmethod
     def merged_report(stats_list) -> dict:

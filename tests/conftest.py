@@ -27,6 +27,13 @@ import pytest  # noqa: E402
 import scipy.sparse as sp  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 budget run (-m 'not slow'); "
+        "run the full suite with plain `pytest tests/`")
+
+
 def er_graph(n: int = 48, p: float = 0.15, seed: int = 1) -> sp.csr_matrix:
     """Symmetric Erdős–Rényi graph, no self-loops, float32."""
     rng = np.random.default_rng(seed)

@@ -7,18 +7,26 @@ self-provision a virtual CPU mesh, in-process when the backend already has
 enough devices and via subprocess re-exec when it does not.
 """
 
+import pytest
+
 import __graft_entry__ as graft
 
 
 def test_dryrun_multichip_in_process():
-    # conftest provides 8 virtual CPU devices, so this takes the direct path
-    graft.dryrun_multichip(8)
+    # conftest provides 8 virtual CPU devices, so this takes the direct path;
+    # dryrun degrades to a status dict instead of raising, so assert ok
+    assert graft.dryrun_multichip(8)["ok"] is True
 
 
+@pytest.mark.slow   # full re-exec of the 16-device dry run: ~85 s of the
+                    # tier-1 budget for a pure subprocess-plumbing variant of
+                    # the in-process test above
 def test_dryrun_multichip_subprocess_self_provisions():
     # asking for more devices than the live backend has forces the driver
     # fallback: re-exec in a subprocess with the virtual-mesh env vars
-    graft.dryrun_multichip(16)
+    # (ok must be asserted — a deadline/backend degradation returns a
+    # marked dict instead of raising)
+    assert graft.dryrun_multichip(16)["ok"] is True
 
 
 def test_entry_forward_compiles():

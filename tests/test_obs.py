@@ -34,16 +34,26 @@ def test_commstats_conservation_and_report():
 
     st.count_step(nlayers=3)       # 3 fwd + 3 bwd exchanges
     st.count_forward(nlayers=2)    # inference adds fwd-only exchanges
-    assert st.exchanges == 8
+    st.count_step(nlayers=3, hidden=True)   # a pipelined (stale) step
+    assert st.exchanges == 14
+    assert st.hidden_exchanges == 6
     rep = st.report()
     per_ex = int(st.send_volume_per_exchange.sum())
-    assert rep["total_send_volume"] == 8 * per_ex
+    assert rep["total_send_volume"] == 14 * per_ex
     assert rep["total_recv_volume"] == rep["total_send_volume"]
-    assert rep["max_send_volume"] == 8 * int(st.send_volume_per_exchange.max())
+    assert rep["max_send_volume"] == 14 * int(st.send_volume_per_exchange.max())
+    # hidden/exposed split: totals keep the reference meaning (all bytes
+    # cross the wire); the split attributes them to the critical path or not
+    assert rep["exposed_exchanges"] == 8
+    assert rep["hidden_exchanges"] == 6
+    assert rep["exposed_send_volume"] == 8 * per_ex
+    assert rep["hidden_send_volume"] == 6 * per_ex
     assert set(rep) == {
         "total_send_volume", "max_send_volume", "total_send_msgs",
         "max_send_msgs", "total_recv_volume", "max_recv_volume",
-        "total_recv_msgs", "max_recv_msgs"}
+        "total_recv_msgs", "max_recv_msgs", "exchanges",
+        "exposed_exchanges", "hidden_exchanges", "exposed_send_volume",
+        "hidden_send_volume"}
 
 
 def test_commstats_merged_report_matches_manual_sum():

@@ -40,6 +40,51 @@ def test_proxy_plan_shapes(kplan):
     assert st.recv_volume_per_exchange.shape == (1,)
 
 
+def test_proxy_slicing_is_field_driven(kplan):
+    """Slicing follows the plan's explicit classification, not a shape
+    coincidence: an unclassified field that LOOKS per-chip-stacked fails
+    loudly, and the classification list itself stays in sync with the
+    dataclass (every listed non-None field really is (k, ...))."""
+    import dataclasses
+
+    from sgcn_tpu.parallel.plan import PER_CHIP_ARRAY_FIELDS
+
+    from sgcn_tpu.parallel.plan import CommPlan
+
+    _, plan = kplan
+    # every classified, materialized field carries the stacked leading axis
+    for name in PER_CHIP_ARRAY_FIELDS:
+        v = getattr(plan, name)
+        if v is not None:
+            assert v.shape[0] == plan.k, name
+
+    # a future field that looks per-chip-stacked but is unclassified must
+    # raise, not silently slice or pass through whole
+    @dataclasses.dataclass
+    class RoguePlan(CommPlan):
+        rogue_field: np.ndarray | None = None
+
+    rogue = RoguePlan(
+        **{f.name: getattr(plan, f.name) for f in dataclasses.fields(plan)},
+        rogue_field=np.zeros((plan.k, 3), dtype=np.float32))
+    with pytest.raises(ValueError, match="not classified"):
+        shard_proxy_plan(rogue, chip=1)
+
+
+def test_proxy_asymmetric_stats_fail_loudly(kplan):
+    """CommStats on an asymmetric proxied plan must refuse to fabricate
+    recv counters (round-5 advisor finding)."""
+    import dataclasses
+
+    from sgcn_tpu.utils.stats import CommStats
+
+    _, plan = kplan
+    proxy = shard_proxy_plan(plan, chip=0)
+    asym = dataclasses.replace(proxy, symmetric=False)
+    with pytest.raises(ValueError, match="ASYMMETRIC"):
+        CommStats.from_plan(asym)
+
+
 def test_proxy_trains_gcn_and_gat(kplan):
     """The proxy runs chip 0's full train step (send gather, halo gather,
     bucketed SpMM, backward, Adam) on a 1-device mesh with finite losses —

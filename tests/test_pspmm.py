@@ -111,7 +111,14 @@ def test_overlap_backward_parity(ahat):
 
         def obj(hl):
             out = pspmm_overlap(hl, *_overlap_args(pa))
-            return jax.lax.psum(jnp.sum(out * w[0]), "v")
+            # per-chip LOCAL objective: its grad is still the GLOBAL
+            # d(sum over chips)/dh — every chip runs the same transposed
+            # exchange, so cotangents for rows this chip owns arrive from
+            # all consumers.  (A psum'd objective hits the old
+            # psum-transposes-to-psum convention on jaxlib 0.4.37 and
+            # comes back k-times inflated; the local form is
+            # convention-independent.)
+            return jnp.sum(out * w[0])
 
         return jax.grad(obj)(h[0])[None]
 
@@ -173,7 +180,14 @@ def test_ell_sym_backward_parity(ahat):
 
         def obj(hl):
             out = pspmm_ell_sym(hl, *_sym_args(pa), plan.ell_buckets)
-            return jax.lax.psum(jnp.sum(out * w[0]), "v")
+            # per-chip LOCAL objective: its grad is still the GLOBAL
+            # d(sum over chips)/dh — every chip runs the same transposed
+            # exchange, so cotangents for rows this chip owns arrive from
+            # all consumers.  (A psum'd objective hits the old
+            # psum-transposes-to-psum convention on jaxlib 0.4.37 and
+            # comes back k-times inflated; the local form is
+            # convention-independent.)
+            return jnp.sum(out * w[0])
 
         return jax.grad(obj)(h[0])[None]
 
@@ -207,7 +221,14 @@ def test_directed_graph_detected_not_symmetric():
 
         def obj(hl):
             out = pspmm_overlap(hl, *_overlap_args(pa))
-            return jax.lax.psum(jnp.sum(out * w[0]), "v")
+            # per-chip LOCAL objective: its grad is still the GLOBAL
+            # d(sum over chips)/dh — every chip runs the same transposed
+            # exchange, so cotangents for rows this chip owns arrive from
+            # all consumers.  (A psum'd objective hits the old
+            # psum-transposes-to-psum convention on jaxlib 0.4.37 and
+            # comes back k-times inflated; the local form is
+            # convention-independent.)
+            return jnp.sum(out * w[0])
 
         return jax.grad(obj)(h[0])[None]
 
@@ -306,7 +327,14 @@ def test_backward_parity(ahat):
         def obj(hl):
             out = pspmm_exchange(hl, pa["send_idx"], pa["halo_src"],
                                  pa["edge_dst"], pa["edge_src"], pa["edge_w"])
-            return jax.lax.psum(jnp.sum(out * w[0]), "v")
+            # per-chip LOCAL objective: its grad is still the GLOBAL
+            # d(sum over chips)/dh — every chip runs the same transposed
+            # exchange, so cotangents for rows this chip owns arrive from
+            # all consumers.  (A psum'd objective hits the old
+            # psum-transposes-to-psum convention on jaxlib 0.4.37 and
+            # comes back k-times inflated; the local form is
+            # convention-independent.)
+            return jnp.sum(out * w[0])
 
         return jax.grad(obj)(h[0])[None]
 

@@ -115,7 +115,10 @@ def test_cli_accuracy_experiment_npz_minibatch():
     assert abs(rep["oracle_test_acc"] - rep["minibatch_test_acc"]) < 0.05
 
 
-@pytest.mark.parametrize("k", [4, 8])
+@pytest.mark.parametrize(
+    "k", [4, pytest.param(8, marks=pytest.mark.slow)])  # k=8 re-runs the
+    # same 1433-wide CLI pipeline for ~75 s of tier-1 budget; k=4 is the
+    # budgeted representative
 def test_cli_accuracy_cora_true_shape(k):
     """The accuracy experiment at cora's TRUE dims (VERDICT r3 item 3):
     2708 x 1433 x 7, planetoid split (20/class train, 1000 test), oracle vs

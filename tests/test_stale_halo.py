@@ -1,0 +1,251 @@
+"""Pipelined stale-halo exchange (``--halo-staleness``): the trainer's
+bounded-staleness mode (PipeGCN-style features+gradients, ``pspmm_stale``)
+with halo-delta caching and the periodic full-sync schedule.
+
+Contract pinned here:
+
+  * ``halo_staleness=0`` (the default) IS the pre-existing trainer — same
+    code path, bit-identical losses and parameters on the cora fixture;
+  * ``sync_every=1`` makes every step a full-sync step, which is exact-mode
+    math — losses match the exact trainer to f32 tolerance;
+  * staleness-1 training converges to oracle-parity test accuracy on the
+    cora fixture within a bounded extra-epoch budget;
+  * the delta cache's wire is bf16 (and only the FEATURE wire — the
+    gradient exchange keeps its own dtype);
+  * ``CommStats`` splits hidden (pipelined) from exposed (sync) exchanges.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from sgcn_tpu.io.datasets import er_graph, load_npz_dataset
+from sgcn_tpu.parallel import build_comm_plan
+from sgcn_tpu.parallel.mesh import shard_stacked
+from sgcn_tpu.partition import balanced_random_partition
+from sgcn_tpu.partition.emit import read_partvec
+from sgcn_tpu.prep import normalize_adjacency
+from sgcn_tpu.train import FullBatchTrainer, make_train_data
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+@pytest.fixture(scope="module")
+def cora():
+    """The committed cora-format fixture + its 4-way hp partvec."""
+    a, feats, labels = load_npz_dataset(os.path.join(FIX, "cora_like.npz"))
+    ahat = normalize_adjacency(a)
+    pv = read_partvec(os.path.join(FIX, "cora_like.4.hp"))
+    plan = build_comm_plan(ahat, pv, 4)
+    return plan, feats.astype(np.float32), labels.astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def erplan():
+    n, k = 800, 8
+    ahat = normalize_adjacency(er_graph(n, 8, seed=0))
+    pv = balanced_random_partition(n, k, seed=1)
+    plan = build_comm_plan(ahat, pv, k)
+    rng = np.random.default_rng(0)
+    feats = rng.standard_normal((n, 16)).astype(np.float32)
+    labels = rng.integers(0, 4, n).astype(np.int32)
+    return plan, feats, labels
+
+
+@pytest.fixture(scope="module")
+def exact_losses(erplan):
+    """8 exact-mode training losses — the shared reference for every
+    tracking assertion (one trainer compile for the whole module)."""
+    plan, feats, labels = erplan
+    tr = FullBatchTrainer(plan, fin=16, widths=[8, 4], seed=2)
+    d = make_train_data(plan, feats, labels)
+    return [tr.step(d) for _ in range(8)]
+
+
+def _params_np(tr):
+    return [np.asarray(w) for w in tr.params]
+
+
+def test_staleness0_bit_identical_to_default(cora):
+    """``halo_staleness=0`` must be THE default trainer: same program, same
+    bits — losses and parameters exactly equal after training on the cora
+    fixture."""
+    plan, feats, labels = cora
+    tr_default = FullBatchTrainer(plan, fin=feats.shape[1], widths=[16, 7],
+                                  seed=3)
+    tr_zero = FullBatchTrainer(plan, fin=feats.shape[1], widths=[16, 7],
+                               seed=3, halo_staleness=0)
+    d = make_train_data(plan, feats, labels)
+    l_default = [tr_default.step(d) for _ in range(3)]
+    l_zero = [tr_zero.step(d) for _ in range(3)]
+    assert l_default == l_zero                       # bitwise, not allclose
+    for a, b in zip(_params_np(tr_default), _params_np(tr_zero)):
+        np.testing.assert_array_equal(a, b)
+    # and the exact path carries no stale machinery at all
+    assert not hasattr(tr_zero, "halo_carry")
+
+
+def test_sync_every_1_is_exact_math(erplan, exact_losses):
+    """Every-step full sync consumes only fresh halos — the stale program
+    degenerates to exact-mode math (different program, same numbers)."""
+    plan, feats, labels = erplan
+    d = make_train_data(plan, feats, labels)
+    tr_sync = FullBatchTrainer(plan, fin=16, widths=[8, 4], seed=2,
+                               halo_staleness=1, sync_every=1)
+    got = [tr_sync.step(d) for _ in range(5)]
+    np.testing.assert_allclose(got, exact_losses[:5], rtol=1e-5, atol=1e-6)
+
+
+def test_stale1_tracks_run_epochs_and_stats(erplan, exact_losses):
+    """Plain staleness-1: finite, tracks exact training closely after a few
+    steps; the fused ``run_epochs`` path reproduces per-step ``step()``
+    (including the sync-step scheduling around the loop); and CommStats
+    books the sync steps (0, 3, 6) as exposed, the rest as hidden."""
+    plan, feats, labels = erplan
+    d = make_train_data(plan, feats, labels)
+    tr_a = FullBatchTrainer(plan, fin=16, widths=[8, 4], seed=2,
+                            halo_staleness=1, sync_every=3)
+    la = [tr_a.step(d) for _ in range(8)]
+    assert np.all(np.isfinite(la))
+    assert abs(la[-1] - exact_losses[-1]) < 5e-2
+    tr_b = FullBatchTrainer(plan, fin=16, widths=[8, 4], seed=2,
+                            halo_staleness=1, sync_every=3)
+    lb = tr_b.run_epochs(d, 8)
+    np.testing.assert_allclose(lb, la, rtol=2e-4, atol=1e-5)
+
+    # exposed/hidden accounting: 8 steps, sync at 0/3/6 → 3 exposed
+    rep = tr_a.stats.report()
+    nl = tr_a.nlayers
+    per_ex = int(tr_a.stats.send_volume_per_exchange.sum())
+    assert rep["exchanges"] == 8 * 2 * nl
+    assert rep["exposed_exchanges"] == 3 * 2 * nl
+    assert rep["hidden_exchanges"] == 5 * 2 * nl
+    assert rep["hidden_send_volume"] == per_ex * 5 * 2 * nl
+    assert rep["exposed_send_volume"] == per_ex * 3 * 2 * nl
+    assert rep["total_send_volume"] == \
+        rep["hidden_send_volume"] + rep["exposed_send_volume"]
+    # run_epochs books the same schedule as per-step driving
+    assert tr_b.stats.report() == rep
+
+
+def test_stale1_convergence_oracle_parity(cora):
+    """The accuracy contract: staleness-1 (with the delta wire and periodic
+    sync — the full pipelined config) reaches oracle-parity test accuracy on
+    the cora fixture within a 1.5× epoch budget."""
+    from sgcn_tpu.baselines import DenseOracle
+    from sgcn_tpu.io.datasets import planetoid_split
+
+    plan, feats, labels = cora
+    train_mask, test_mask = planetoid_split(labels, per_class=20, seed=0)
+    widths = [32, int(labels.max()) + 1]
+    epochs = 30
+
+    # oracle on the same normalized adjacency the plan was built from
+    ahat, _, _ = load_npz_dataset(os.path.join(FIX, "cora_like.npz"))
+    oracle = DenseOracle(normalize_adjacency(ahat), fin=feats.shape[1],
+                         widths=widths, seed=7)
+    oracle.fit(feats, labels, mask=train_mask, epochs=epochs)
+    pred = oracle.predict(feats).argmax(1)
+    oracle_acc = float((pred == labels)[test_mask == 1.0].mean())
+    assert oracle_acc > 0.6                       # far above 1/7 chance
+
+    tr = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths, seed=7,
+                          halo_staleness=1, halo_delta=True, sync_every=10)
+    d = make_train_data(plan, feats, labels, train_mask=train_mask,
+                        eval_mask=test_mask)
+    tr.run_epochs(d, int(epochs * 1.5))           # bounded extra-epoch budget
+    _, acc = tr.evaluate(d)
+    assert acc >= oracle_acc - 0.05, (acc, oracle_acc)
+
+
+def test_delta_wire_is_bf16_feature_only(erplan):
+    """The delta cache ships bf16 on the FEATURE wire; the gradient
+    exchange keeps f32 (its own ``halo_dtype`` lever) — so the lowered
+    stale step carries BOTH a bf16 and an f32 all_to_all."""
+    plan, feats, labels = erplan
+    tr = FullBatchTrainer(plan, fin=16, widths=[8, 4], seed=2,
+                          halo_staleness=1, halo_delta=True)
+    d = make_train_data(plan, feats, labels)
+    d = type(d)(**shard_stacked(tr.mesh, vars(d)))
+    txt = tr._step_stale.lower(
+        tr.params, tr.opt_state, tr.halo_carry, tr.pa, d.h0, d.labels,
+        d.train_valid).as_text()
+    a2a_types = re.findall(
+        r'"?stablehlo\.all_to_all"?.*?->\s*tensor<[0-9x]*(f32|bf16)>', txt)
+    assert a2a_types, "no all_to_all in lowered stale step?"
+    assert set(a2a_types) == {"bf16", "f32"}, a2a_types
+
+    # with halo_dtype='bfloat16' the gradient wire narrows too
+    tr2 = FullBatchTrainer(plan, fin=16, widths=[8, 4], seed=2,
+                           halo_staleness=1, halo_delta=True,
+                           halo_dtype="bfloat16")
+    txt2 = tr2._step_stale.lower(
+        tr2.params, tr2.opt_state, tr2.halo_carry, tr2.pa, d.h0, d.labels,
+        d.train_valid).as_text()
+    a2a_types2 = re.findall(
+        r'"?stablehlo\.all_to_all"?.*?->\s*tensor<[0-9x]*(f32|bf16)>', txt2)
+    assert set(a2a_types2) == {"bf16"}, a2a_types2
+
+
+def test_delta_numerics_track_exact(erplan, exact_losses):
+    """bf16 delta accumulation quantizes only boundary rows — training must
+    track the exact trainer to bf16-wire tolerance over several steps."""
+    plan, feats, labels = erplan
+    d = make_train_data(plan, feats, labels)
+    tr = FullBatchTrainer(plan, fin=16, widths=[8, 4], seed=2,
+                          halo_staleness=1, halo_delta=True, sync_every=2)
+    l_d = [tr.step(d) for _ in range(6)]
+    np.testing.assert_allclose(l_d, exact_losses[:6], rtol=1e-2, atol=1e-2)
+
+
+def test_stale_carry_shapes_follow_exchange_widths(erplan):
+    """The plan's carry-shape helper mirrors the forward's project-first
+    exchanged widths, and the delta baseline matches the send buffer."""
+    from sgcn_tpu.models.gcn import exchange_widths
+
+    plan, *_ = erplan
+    fin, widths = 300, [64, 4]          # wide input → project-first layer 0
+    shapes = plan.stale_carry_shapes(fin, widths, delta=True)
+    fs = exchange_widths(fin, widths)
+    assert fs[0] == 64                  # projected before the exchange
+    assert shapes["halos"] == [(plan.r, f) for f in fs]
+    assert shapes["ghalos"] == shapes["halos"]
+    assert shapes["bases"] == [(plan.k, plan.s, f) for f in fs]
+    nd = plan.stale_carry_shapes(fin, widths, delta=False)
+    assert nd["bases"] == [(1, 1, 1)] * len(fs)
+
+
+def test_stale_mode_gating(erplan):
+    """Invalid knob combinations fail loudly at construction."""
+    plan, *_ = erplan
+    with pytest.raises(ValueError, match="halo_staleness"):
+        FullBatchTrainer(plan, fin=16, widths=[8, 4], halo_staleness=2)
+    with pytest.raises(ValueError, match="requires halo_staleness"):
+        FullBatchTrainer(plan, fin=16, widths=[8, 4], halo_delta=True)
+    with pytest.raises(ValueError, match="requires halo_staleness"):
+        FullBatchTrainer(plan, fin=16, widths=[8, 4], sync_every=4)
+    with pytest.raises(ValueError, match="GCN hot path"):
+        FullBatchTrainer(plan, fin=16, widths=[8, 4], model="gat",
+                         halo_staleness=1)
+    with pytest.raises(ValueError, match="f32 non-remat"):
+        FullBatchTrainer(plan, fin=16, widths=[8, 4], halo_staleness=1,
+                         compute_dtype="bfloat16")
+
+
+def test_stale_rejects_asymmetric_plan():
+    """The stale custom backward assumes Â = Âᵀ; an asymmetric plan must be
+    rejected, not silently mis-trained."""
+    import scipy.sparse as sp
+
+    n, k = 60, 4
+    rng = np.random.default_rng(0)
+    a = sp.csr_matrix((rng.random((n, n)) < 0.1).astype(np.float32))
+    a.setdiag(0)
+    a.eliminate_zeros()
+    pv = balanced_random_partition(n, k, seed=1)
+    plan = build_comm_plan(a, pv, k)
+    assert not plan.symmetric
+    with pytest.raises(ValueError, match="asymmetric"):
+        FullBatchTrainer(plan, fin=8, widths=[4, 3], halo_staleness=1)
