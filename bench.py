@@ -240,16 +240,23 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
                 "gather-stream roofline does not describe this program")
         else:
             # roofline self-description (VERDICT r4 item 7): achieved
-            # gathered GB/s vs the measured stream ceiling.  Plan fields are
-            # per-chip padded sizes, so this is per-chip traffic (= global
-            # when k=1); bf16 compute gathers 2-byte lanes
-            gb = gather_bytes_per_epoch(
-                plan, feats.shape[1], widths,
-                itemsize=2 if dtype == "bfloat16" else 4)
-            part_metrics["gather_GB_per_epoch_per_chip"] = round(gb / 1e9, 3)
-            part_metrics["achieved_gather_GBs"] = round(gb / epoch_s / 1e9, 1)
+            # gathered GB/s vs the measured stream ceiling, from the SAME
+            # analytic cost model the run-telemetry subsystem attributes
+            # per-step events with (sgcn_tpu.obs.attribution — this used to
+            # be hand-rolled here).  Plan fields are per-chip padded sizes,
+            # so this is per-chip traffic (= global when k=1); bf16 compute
+            # gathers 2-byte lanes
+            from sgcn_tpu.obs.attribution import (roofline_fields, step_cost)
+            cost = step_cost(plan, feats.shape[1], widths,
+                             compute_dtype=dtype)
+            roof = roofline_fields(cost, epoch_s)
+            part_metrics["gather_GB_per_epoch_per_chip"] = round(
+                cost.gather_bytes / 1e9, 3)
+            part_metrics["achieved_gather_GBs"] = round(
+                roof["achieved_gather_GBs"], 1)
             part_metrics["stream_ceiling_frac"] = round(
-                gb / epoch_s / 1e9 / STREAM_CEILING_GBS, 3)
+                roof["stream_ceiling_frac"], 3)
+            part_metrics["model_step_GFLOP"] = roof["model_step_GFLOP"]
     return epoch_s, part_metrics
 
 
@@ -293,31 +300,9 @@ def bench_minibatch(ahat, feats, labels, widths, batch_size: int,
     }
 
 
-# Measured achievable HBM stream rate through XLA on this chip (BASELINE.md
-# microbenchmarks: 655 GB/s = 80% of nominal); the denominator of the
-# gather-utilization figure — the MFU-analogue for this gather-bound workload.
-STREAM_CEILING_GBS = 655.0
-
-
-def gather_bytes_per_epoch(plan, fin: int, widths,
-                           itemsize: int = 4) -> int:
-    """Bytes the epoch's row gathers move (fwd + symmetric bwd), from the
-    plan's padded layout — the numerator of the roofline figure.
-
-    Counts the gather streams only (ELL slots, hub tails, halo-src edges,
-    send-buffer and halo-buffer gathers), at the aggregation width of each
-    layer (``models/gcn.py::exchange_widths`` — the trainer's project-first
-    rule).  Accumulate-side traffic (~30% more, BASELINE.md utilization
-    accounting) is deliberately excluded: the metric is 'how fast are the
-    gathers running', matching the measured 655 GB/s stream ceiling
-    denominator.
-    """
-    from sgcn_tpu.models.gcn import exchange_widths
-    ell_slots = sum(nb * wb for nb, wb in plan.ell_buckets)
-    rows = ell_slots + plan.tl          # local ELL + tail
-    rows += plan.eh                     # halo-src edge gathers
-    rows += plan.k * plan.s + plan.r    # send-buffer + halo-table gathers
-    return int(2 * rows * itemsize * sum(exchange_widths(fin, widths)))
+# The roofline vocabulary (measured stream ceiling, gather-byte model) moved
+# to sgcn_tpu/obs/attribution.py — ONE cost model shared by this bench, the
+# per-step run-telemetry events, and scripts/obs_report.py.
 
 
 def bench_dense_equiv(n: int, fin: int, widths, epochs: int) -> float:
@@ -738,6 +723,25 @@ def products_partition_block() -> dict:
         return {}
 
 
+def _emit_result(result: dict, args) -> None:
+    """Print the one-line JSON and, under ``--metrics-out``, also persist it
+    as a run directory (manifest + summary event) through the telemetry
+    subsystem — the same loadable shape as a trainer run, so bench results
+    and training runs share one loader (``sgcn_tpu.obs.load_run``)."""
+    print(json.dumps(result))
+    out = getattr(args, "metrics_out", None)
+    if not out:
+        return
+    try:
+        from sgcn_tpu.obs import RunRecorder
+
+        with RunRecorder(out, config={k: v for k, v in vars(args).items()},
+                         run_kind="bench") as rec:
+            rec.record_summary(result)
+    except Exception as e:              # noqa: BLE001 — observability only
+        print(f"# --metrics-out write failed: {e!r}", file=sys.stderr)
+
+
 def main() -> None:
     # async all-to-all on TPU meshes (no-op single-chip / CPU): the halo
     # exchange only overlaps the local slot passes when the collective is
@@ -800,6 +804,10 @@ def main() -> None:
                         "session (same_session_baseline_s).  Default: for "
                         "GB-table runs (-n >= 1M) the rev pinned in "
                         "bench_artifacts/ab_baseline_rev; 'none' disables")
+    p.add_argument("--metrics-out", default=None, metavar="DIR",
+                   help="also persist the result as a telemetry run "
+                        "directory (manifest + summary event, "
+                        "sgcn_tpu.obs; render with scripts/obs_report.py)")
     p.add_argument("--skip-vdev", action="store_true",
                    help="skip the virtual-8-device partitioned diagnostic run")
     p.add_argument("--vdev-n", type=int, default=120_000,
@@ -850,14 +858,14 @@ def main() -> None:
                                            dtype=args.dtype)
         if args.dtype:
             mb_metrics["compute_dtype"] = args.dtype
-        print(json.dumps({
+        _emit_result({
             "metric": "minibatch_gcn_epoch_time",
             "value": round(mb_s, 6),
             "unit": "s",
             "graph": args.graph,
             "measurement": dict(_diff_time_quality),
             **mb_metrics,
-        }))
+        }, args)
         return
 
     # graceful degradation (round-5 verdict headline): a missing TPU backend
@@ -881,13 +889,13 @@ def main() -> None:
                 halo_delta=args.halo_delta, sync_every=args.sync_every,
                 step_dispatch=args.step_dispatch)
     except _PhaseDeadlineExpired as e:
-        print(json.dumps({**partial, "degraded": str(e)}))
+        _emit_result({**partial, "degraded": str(e)}, args)
         return
     except Exception as e:                      # noqa: BLE001 — classify below
         if _backend_unavailable(e):
-            print(json.dumps({**partial,
-                              "skipped": f"TPU backend unavailable: "
-                                         f"{str(e)[:300]}"}))
+            _emit_result({**partial,
+                          "skipped": f"TPU backend unavailable: "
+                                     f"{str(e)[:300]}"}, args)
             return
         raise
     flagship_quality = dict(_diff_time_quality)   # before later diff_time calls
@@ -949,7 +957,7 @@ def main() -> None:
             "1.2 GB feature table gathers at ~176 Mrows/s vs ~444 Mrows/s "
             "at 83 MB on this chip; k-way sharding moves per-chip tables "
             "back to the fast side (BASELINE.md)")
-    print(json.dumps({
+    _emit_result({
         "metric": f"fullbatch_{args.model}_epoch_time",
         "value": round(epoch_s, 6),
         "unit": "s",
@@ -970,7 +978,7 @@ def main() -> None:
         **part_metrics,
         **vdev_metrics,
         **extra,
-    }))
+    }, args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,184 @@
+"""Render a run-telemetry directory into a human-readable summary.
+
+Usage::
+
+    python scripts/obs_report.py RUNDIR [--steps N]
+
+Loads (and schema-validates) the directory written by ``--metrics-out``
+(trainer CLI, ``bench.py``) and prints:
+
+  * the manifest header (run kind, config highlights, git rev, backend,
+    plan digest + partitioner provenance);
+  * the step table: loss / grad-norm / wall-time statistics, roofline
+    utilization, the hidden-vs-exposed comm split, and — for stale-halo
+    runs — the drift-gauge columns (staleness age, per-layer drift,
+    quantization error);
+  * eval records, summary report, and the heartbeat timeline (the
+    "slow vs stalled" signal of the launch/dryrun layers).
+
+Read-only; a run directory that fails validation prints the schema error
+and exits non-zero — this script is also the quickest way to check one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt(x, nd=4):
+    if x is None:
+        return "-"
+    if isinstance(x, float):
+        return f"{x:.{nd}g}"
+    return str(x)
+
+
+def _stats(vals):
+    if not vals:
+        return "-"
+    lo, hi = min(vals), max(vals)
+    mean = sum(vals) / len(vals)
+    return f"{_fmt(mean)} (min {_fmt(lo)}, max {_fmt(hi)})"
+
+
+def render(path: str, max_steps: int = 12) -> str:
+    from sgcn_tpu.obs import load_run
+
+    log = load_run(path)
+    m = log.manifest
+    lines = [f"run: {path}"]
+    if m:
+        lines.append(f"  kind={m['run_kind']}  schema=v{m['v']}  "
+                     f"git={(m.get('git_rev') or '?')[:10]}")
+    else:
+        lines.append("  (heartbeats only — no manifest; the launch/dryrun "
+                     "layers ping without a RunRecorder)")
+    be = m.get("backend")
+    if be:
+        lines.append(f"  backend: {be.get('platform')} × "
+                     f"{be.get('device_count')} devices, "
+                     f"{be.get('process_count')} process(es)")
+    pl = m.get("plan")
+    if pl:
+        lines.append(
+            f"  plan: n={pl['n']} k={pl['k']} b={pl['b']} r={pl['r']} "
+            f"symmetric={pl['symmetric']} digest={pl['digest']}")
+        lines.append(
+            f"        send rows/exchange={pl['send_rows_per_exchange']} "
+            f"messages/exchange={pl['messages_per_exchange']}")
+    pt = m.get("partitioner")
+    if pt:
+        lines.append("  partitioner: "
+                     + " ".join(f"{k}={v}" for k, v in pt.items()))
+    cfg = m.get("config", {})
+    knobs = {k: cfg[k] for k in ("model", "loss", "halo_staleness",
+                                 "halo_delta", "sync_every", "dtype",
+                                 "halo_dtype", "epochs", "batch_size")
+             if cfg.get(k)}
+    if knobs:
+        lines.append("  config: "
+                     + " ".join(f"{k}={v}" for k, v in knobs.items()))
+
+    steps = log.steps()
+    if steps:
+        lines.append(f"\nsteps: {len(steps)}")
+        lines.append("  loss:      first " + _fmt(steps[0]["loss"])
+                     + " → last " + _fmt(steps[-1]["loss"]))
+        gn = [s["grad_norm"] for s in steps if s.get("grad_norm") is not None]
+        if gn:
+            lines.append("  grad_norm: " + _stats(gn))
+        lines.append("  wall_s:    "
+                     + _stats([s["wall_s"] for s in steps]))
+        roofs = [s["roofline"] for s in steps if s.get("roofline")]
+        if roofs:
+            lines.append("  roofline:  gather "
+                         + _stats([r["achieved_gather_GBs"] for r in roofs])
+                         + " GB/s, stream-ceiling frac "
+                         + _stats([r["stream_ceiling_frac"] for r in roofs]))
+            ef = [r["exposed_comm_frac"] for r in roofs
+                  if "exposed_comm_frac" in r]
+            if ef:
+                lines.append("  exposed-comm frac: " + _stats(ef))
+        comm = steps[-1].get("comm")
+        if comm:
+            lines.append(
+                f"  comm (cumulative): {comm['exchanges']} exchanges = "
+                f"{comm['exposed_exchanges']} exposed + "
+                f"{comm['hidden_exchanges']} hidden; send rows "
+                f"{comm['total_send_volume']} = "
+                f"{comm['exposed_send_volume']} + "
+                f"{comm['hidden_send_volume']}")
+        drifts = [s["drift"] for s in steps if s.get("drift")]
+        if drifts:
+            lines.append("\ndrift gauges (stale-halo mode):")
+            nl = len(drifts[-1]["halo_drift_rms"])
+            lines.append("  staleness age: last "
+                         + str(drifts[-1]["staleness_age"]) + ", max "
+                         + str(max(d["staleness_age"] for d in drifts)))
+            for layer in range(nl):
+                dr = [d["halo_drift_rms"][layer] for d in drifts]
+                rel = [d["halo_drift_rel"][layer] for d in drifts]
+                qe = [d["halo_quant_err_rms"][layer] for d in drifts]
+                lines.append(f"  layer {layer}: ‖stale−fresh‖ " + _stats(dr)
+                             + f", relative {_fmt(rel[-1])} (last)"
+                             + (f", quant-err {_stats(qe)}"
+                                if any(qe) else ""))
+        hdr = (" step      loss  grad_norm    wall_s  exposed  age"
+               "  drift_rms(last layer)")
+        lines.append("\n" + hdr)
+        show = steps if len(steps) <= max_steps else (
+            steps[: max_steps // 2] + [None] + steps[-max_steps // 2:])
+        for s in show:
+            if s is None:
+                lines.append("  ...")
+                continue
+            d = s.get("drift") or {}
+            r = s.get("roofline") or {}
+            lines.append(
+                f" {s['step']:>4} {_fmt(s['loss'], 6):>9} "
+                f"{_fmt(s.get('grad_norm'), 4):>10} "
+                f"{_fmt(s['wall_s'], 4):>9} "
+                f"{_fmt(r.get('exposed_comm_frac'), 3):>8} "
+                f"{_fmt(d.get('staleness_age')):>4} "
+                f"{_fmt((d.get('halo_drift_rms') or [None])[-1], 4):>10}")
+
+    for ev in log.evals():
+        lines.append(f"\neval @ step {ev['step']}: loss {_fmt(ev['loss'])}"
+                     + (f", acc {_fmt(ev['acc'])}" if "acc" in ev else ""))
+    for sm in log.summaries():
+        rep = sm["report"]
+        keys = [k for k in ("metric", "value", "unit", "epochs", "epoch_s",
+                            "err", "total_send_volume") if k in rep]
+        lines.append("\nsummary: "
+                     + " ".join(f"{k}={_fmt(rep[k])}" for k in keys))
+    if log.heartbeats:
+        lines.append(f"\nheartbeats: {len(log.heartbeats)}")
+        t0 = log.heartbeats[0]["ts"]
+        for hb in log.heartbeats[-20:]:
+            lines.append(f"  +{hb['ts'] - t0:8.2f}s  pid {hb.get('pid')}  "
+                         f"{hb['event']}"
+                         + (f" — {hb['detail']}" if hb.get("detail") else ""))
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("rundir", help="directory written by --metrics-out")
+    ap.add_argument("--steps", type=int, default=12,
+                    help="max rows in the per-step table (head+tail)")
+    args = ap.parse_args()
+    try:
+        print(render(args.rundir, max_steps=args.steps))
+    except (OSError, ValueError) as e:
+        print(f"obs_report: {args.rundir} failed to load: {e}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

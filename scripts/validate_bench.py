@@ -1,0 +1,235 @@
+"""Schema-validate the checked-in bench evidence files.
+
+Usage::
+
+    python scripts/validate_bench.py [ROOT]      # default: repo root
+
+Validates every ``BENCH_*.json`` / ``MULTICHIP_*.json`` at the root and
+every ``bench_artifacts/*.json``, and exits non-zero listing each
+violation.  Run by the tier-1 suite (``tests/test_validate_bench.py``), so
+a hand-edited or wrongly-shaped artifact fails CI instead of silently
+poisoning the evidence chain.
+
+What counts as a violation:
+
+  * **driver records** (``BENCH_*``): missing ``n/cmd/rc/tail``; an rc=0
+    record without a parseable one-line result (``parsed``); a result with
+    ``value: null`` but NO ``skipped``/``degraded`` marker — the graceful-
+    degradation contract says a missing number must explain itself;
+  * **measurement quality**: a ``measurement`` block claiming more clean
+    differential estimates than were targeted (impossible by construction
+    — a hand-edit tell);
+  * **dryrun records** (``MULTICHIP_*``): ``ok: true`` with a non-zero rc,
+    or ``ok: false`` with no ``skipped``/``degraded`` explanation;
+  * **non-standard JSON**: ``NaN``/``Infinity`` tokens — ``json.dumps``
+    emits them for non-finite floats, but they are not valid JSON and no
+    checked-in artifact may carry them;
+  * **the pow2-k RB constraint** (``products_ksweep.json``): ``hp_rb``
+    entries at non-power-of-two k, or k < 32.  The PR-2 review incident:
+    ``partition_hypergraph_rb`` recurses on k/2 and the auto-select
+    (``native/sgcnpart.cpp``) only fires for pow2 k >= 32, so RB results
+    at k ∈ {9, 15, 21, 27} were unreproducible with the code at HEAD and
+    had to be reverted.  This check makes that class of edit impossible to
+    land quietly; if non-pow2 RB support ever lands, regenerate the sweep
+    with ``scripts/products_ksweep.py`` and update this rule WITH it.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import numbers
+import os
+import sys
+
+
+def _load_strict(path: str):
+    """Parse refusing the NaN/Infinity extensions (hand-edit / bad-generator
+    tell — not valid JSON, and every reader downstream would choke)."""
+    def bad_constant(name):
+        raise ValueError(f"non-standard JSON constant {name!r}")
+
+    with open(path) as fh:
+        return json.load(fh, parse_constant=bad_constant)
+
+
+def _is_num(x) -> bool:
+    return isinstance(x, numbers.Real) and not isinstance(x, bool)
+
+
+def check_bench_record(rec: dict) -> list[str]:
+    errs = []
+    for key, typ in (("n", numbers.Integral), ("cmd", str),
+                     ("rc", numbers.Integral), ("tail", str)):
+        if not isinstance(rec.get(key), typ):
+            errs.append(f"missing/badly-typed driver key {key!r}")
+    if errs:
+        return errs
+    if rec["rc"] == 0:
+        parsed = rec.get("parsed")
+        if not isinstance(parsed, dict):
+            errs.append("rc=0 but no parsed one-line JSON result")
+            return errs
+        if not isinstance(parsed.get("metric"), str):
+            errs.append("parsed result missing string 'metric'")
+        if "value" not in parsed:
+            errs.append("parsed result missing 'value'")
+        elif parsed["value"] is None:
+            if not (isinstance(parsed.get("skipped"), str)
+                    or isinstance(parsed.get("degraded"), str)):
+                errs.append("value=null without a skipped/degraded marker "
+                            "(graceful-degradation contract)")
+        elif not _is_num(parsed["value"]):
+            errs.append(f"value is {type(parsed['value']).__name__}, "
+                        "expected number or null")
+        meas = parsed.get("measurement")
+        if isinstance(meas, dict) and meas:
+            ce, te = meas.get("clean_estimates"), meas.get("target_estimates")
+            if not (isinstance(ce, numbers.Integral)
+                    and isinstance(te, numbers.Integral)
+                    and 1 <= ce <= te):
+                errs.append(f"measurement block inconsistent: "
+                            f"clean={ce} target={te}")
+    return errs
+
+
+def check_multichip_record(rec: dict) -> list[str]:
+    errs = []
+    if not isinstance(rec.get("n_devices"), numbers.Integral):
+        errs.append("missing/badly-typed n_devices")
+    if not isinstance(rec.get("ok"), bool):
+        errs.append("missing/badly-typed ok")
+        return errs
+    if rec["ok"]:
+        if rec.get("rc", 0) != 0:
+            errs.append(f"ok=true with rc={rec.get('rc')}")
+    elif rec.get("rc", 0) == 0 and not (rec.get("skipped")
+                                        or rec.get("degraded")):
+        # a clean exit claiming failure must say why; a non-zero rc is its
+        # own explanation (historical pre-contract records: rc=1 round 1,
+        # rc=124 round 5)
+        errs.append("ok=false, rc=0, and no skipped/degraded explanation")
+    return errs
+
+
+def _pow2(k: int) -> bool:
+    return k >= 1 and (k & (k - 1)) == 0
+
+
+def check_products_ksweep(rec: dict) -> list[str]:
+    errs = []
+    sweep = rec.get("sweep")
+    if not isinstance(sweep, dict):
+        return ["missing 'sweep' block"]
+    for fam, by_k in sweep.items():
+        for kstr, entry in by_k.items():
+            try:
+                k = int(kstr)
+            except ValueError:
+                errs.append(f"{fam}: non-integer k key {kstr!r}")
+                continue
+            for method, block in entry.items():
+                if not isinstance(block, dict):
+                    continue
+                km1 = block.get("km1")
+                if not (_is_num(km1) and km1 > 0):
+                    errs.append(f"{fam}/k={k}/{method}: km1={km1!r}")
+                ts = block.get("time_s")
+                if ts is not None and not (_is_num(ts) and ts > 0):
+                    errs.append(f"{fam}/k={k}/{method}: time_s={ts!r}")
+            if "hp_rb" in entry and not (_pow2(k) and k >= 32):
+                errs.append(
+                    f"{fam}/k={k}: hp_rb entry at non-pow2 or <32 k — "
+                    "partition_hypergraph_rb recurses on k/2 and the "
+                    "auto-select fires only for pow2 k>=32; this shape is "
+                    "unreproducible with the code at HEAD (the reverted "
+                    "PR-2 hand-edit)")
+    return errs
+
+
+def check_products_partition(rec: dict) -> list[str]:
+    errs = []
+    g = rec.get("graph")
+    if not (isinstance(g, dict) and _is_num(g.get("n"))
+            and _is_num(g.get("nnz"))):
+        errs.append("missing graph{n, nnz}")
+    if not _is_num(rec.get("k")):
+        errs.append("missing k")
+    for method in ("hp", "rp", "gp"):
+        block = rec.get(method)
+        if not (isinstance(block, dict) and _is_num(block.get("km1"))):
+            errs.append(f"missing {method}.km1")
+    return errs
+
+
+def check_shard_epoch_model(rec: dict) -> list[str]:
+    errs = []
+    cfg = rec.get("config")
+    if not (isinstance(cfg, dict) and _is_num(cfg.get("k"))
+            and _is_num(cfg.get("n"))):
+        errs.append("missing config{k, n}")
+    models = [m for m in ("gcn", "gat")
+              if isinstance(rec.get(m), dict) and "error" not in rec[m]]
+    if not models:
+        errs.append("no usable gcn/gat model block")
+    for m in models:
+        v = rec[m].get("epoch_s_8chip_model")
+        if not (_is_num(v) and v > 0):
+            errs.append(f"{m}.epoch_s_8chip_model={v!r}")
+    return errs
+
+
+# artifact filename -> dedicated checker (everything else: strict-parse only)
+_ARTIFACT_CHECKS = {
+    "products_ksweep.json": check_products_ksweep,
+    "products_partition.json": check_products_partition,
+    "products_partition_dcsbm.json": check_products_partition,
+    "shard_epoch_model.json": check_shard_epoch_model,
+    "shard_epoch_model_dcsbm.json": check_shard_epoch_model,
+    "shard_epoch_model_bf16wire.json": check_shard_epoch_model,
+}
+
+
+def validate_tree(root: str) -> list[str]:
+    """Validate every bench evidence file under ``root``; return violations
+    as ``path: message`` strings (empty = clean)."""
+    problems: list[str] = []
+
+    def run(path, checker):
+        try:
+            rec = _load_strict(path)
+        except (ValueError, json.JSONDecodeError) as e:
+            problems.append(f"{os.path.relpath(path, root)}: unparseable "
+                            f"({e})")
+            return
+        for msg in (checker(rec) if checker else []):
+            problems.append(f"{os.path.relpath(path, root)}: {msg}")
+
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json"))):
+        run(path, check_bench_record)
+    for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_*.json"))):
+        run(path, check_multichip_record)
+    for path in sorted(glob.glob(os.path.join(root, "bench_artifacts",
+                                              "*.json"))):
+        run(path, _ARTIFACT_CHECKS.get(os.path.basename(path)))
+    return problems
+
+
+def main() -> int:
+    root = sys.argv[1] if len(sys.argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    problems = validate_tree(root)
+    if problems:
+        print(f"validate_bench: {len(problems)} violation(s):")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    n = (len(glob.glob(os.path.join(root, "BENCH_*.json")))
+         + len(glob.glob(os.path.join(root, "MULTICHIP_*.json")))
+         + len(glob.glob(os.path.join(root, "bench_artifacts", "*.json"))))
+    print(f"validate_bench: {n} file(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -162,6 +162,7 @@ def gcn_forward_local_stale(
     wire_dtype: str | None = None,  # static: feature-wire dtype
     gwire_dtype: str | None = None,  # static: gradient-wire dtype
     fresh: bool = False,            # static: full-sync step (exact math)
+    gauges: bool = False,           # static: emit per-layer drift gauges
     axis_name: str = AXIS,
 ):
     """Per-chip forward under the pipelined stale-halo exchange.
@@ -174,6 +175,15 @@ def gcn_forward_local_stale(
     the ``ghalos`` cotangents of ``jax.value_and_grad`` (see
     ``pspmm_stale``).  Symmetric-Â plans only — the trainer gates on
     ``plan.symmetric``.
+
+    ``gauges=True`` (the telemetry program the trainer compiles when a
+    ``RunRecorder`` is attached) additionally returns a per-layer list of
+    halo-delta quantization residuals: ``Σ (full − base_next)²`` over the
+    padded send buffer, which is EXACTLY this step's wire rounding error
+    ``(full − base) − quantize(full − base)`` since ``base_next = base +
+    quantized_wire`` — zero when ``delta`` is off (the f32 wire is exact).
+    The extra ``(k, S, f)`` gather per layer exists only in the gauged
+    program; the default hot path is untouched.
     """
     if ell_buckets is None:
         raise ValueError(
@@ -181,7 +191,7 @@ def gcn_forward_local_stale(
     act = get_activation(activation)
     fact = get_activation(final_activation)
     nl = len(params)
-    new_halos, new_bases = [], []
+    new_halos, new_bases, qerrs = [], [], []
     for i, w in enumerate(params):
         # identical scheduling rule to gcn_forward_local: the carry widths
         # (plan.stale_carry_shapes → exchange_widths) encode the same rule
@@ -194,11 +204,19 @@ def gcn_forward_local_stale(
             pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
             pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
             ell_buckets, axis_name, delta, wire_dtype, gwire_dtype, fresh)
+        if gauges:
+            if delta:
+                full = jnp.take(x, pa["send_idx"], axis=0)
+                qerrs.append(jnp.sum(jnp.square(full - bn)))
+            else:
+                qerrs.append(jnp.zeros((), x.dtype))
         if not project_first:
             z = z @ w
         new_halos.append(hn)
         new_bases.append(bn)
         h = fact(z) if i == nl - 1 else act(z)
+    if gauges:
+        return h, new_halos, new_bases, qerrs
     return h, new_halos, new_bases
 
 

@@ -1,0 +1,29 @@
+"""Run-telemetry subsystem: manifest + per-step JSONL events + attribution.
+
+Three layers (see ``docs/observability.md`` for the operator guide):
+
+  * ``recorder``    — ``RunRecorder`` (manifest, append-only event stream,
+    heartbeats) and the ``load_run`` loader;
+  * ``attribution`` — the analytic step cost model (plan-derived SpMM/dense
+    FLOPs, gather bytes, halo wire bytes) joined against measured step time
+    into roofline fields;
+  * ``schema``      — the versioned event vocabulary both of the above are
+    validated against.
+
+Wired through the trainers (``FullBatchTrainer.attach_recorder`` /
+``MiniBatchTrainer.attach_recorder``), the trainer CLI (``--metrics-out``),
+``bench.py`` and the launch/dryrun layers (heartbeats via
+``$SGCN_METRICS_OUT``).  Rendered by ``scripts/obs_report.py``.
+"""
+
+from .attribution import (STREAM_CEILING_GBS, StepCostModel,
+                          gather_bytes_per_epoch, roofline_fields, step_cost)
+from .recorder import RunLog, RunRecorder, heartbeat, load_run, plan_digest
+from .schema import SCHEMA_VERSION, validate_event, validate_manifest
+
+__all__ = [
+    "SCHEMA_VERSION", "STREAM_CEILING_GBS", "RunLog", "RunRecorder",
+    "StepCostModel", "gather_bytes_per_epoch", "heartbeat", "load_run",
+    "plan_digest", "roofline_fields", "step_cost", "validate_event",
+    "validate_manifest",
+]

@@ -1,0 +1,154 @@
+"""Analytic per-step cost attribution — plan-derived FLOPs, bytes, roofline.
+
+One home for every "how fast SHOULD this step be" number, derived from the
+``CommPlan``'s exact padded layout at the per-layer exchanged widths
+(``models.gcn.exchange_widths`` — the trainer's project-first rule), so the
+recorder, ``bench.py`` and ``scripts/obs_report.py`` all attribute measured
+step time against the SAME model.  Previously ``bench.py`` hand-rolled its
+roofline fields; it now imports them from here.
+
+Three quantities per training step:
+
+  * **gather bytes** — what the row gathers move (the workload is
+    gather-bound on v5e; ``BASELINE.md`` microbenchmarks put the achievable
+    stream rate at ``STREAM_CEILING_GBS``).  ``achieved_gather_GBs /
+    STREAM_CEILING_GBS`` is the MFU-analogue for this workload.
+  * **FLOPs** — per-layer SpMM (2·nnz·f) and dense projection (2·B·fin·fout)
+    at the layer's true aggregation width, forward + backward (backward ≈
+    2× the dense forward — dX and dW — plus one more SpMM pass under the
+    symmetric custom VJP).
+  * **halo bytes** — wire bytes per exchange from the plan's predicted send
+    volume (== Σ(λ−1), the partitioner connectivity metric) at the wire
+    dtype, and per step from the exchange count (2·L: forward + backward).
+
+Nothing here imports jax at module scope — the CLIs configure the backend
+before heavy imports, and this module must be importable first.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# Measured achievable HBM stream rate through XLA on this chip (BASELINE.md
+# microbenchmarks: 655 GB/s = 80% of nominal); the denominator of the
+# gather-utilization figure — the MFU-analogue for this gather-bound workload.
+STREAM_CEILING_GBS = 655.0
+
+
+def gather_bytes_per_epoch(plan, fin: int, widths,
+                           itemsize: int = 4) -> int:
+    """Bytes the epoch's row gathers move (fwd + symmetric bwd), from the
+    plan's padded layout — the numerator of the roofline figure.
+
+    Counts the gather streams only (ELL slots, hub tails, halo-src edges,
+    send-buffer and halo-buffer gathers), at the aggregation width of each
+    layer (``models/gcn.py::exchange_widths`` — the trainer's project-first
+    rule).  Accumulate-side traffic (~30% more, BASELINE.md utilization
+    accounting) is deliberately excluded: the metric is 'how fast are the
+    gathers running', matching the measured 655 GB/s stream ceiling
+    denominator.
+    """
+    from ..models.gcn import exchange_widths
+    ell_slots = sum(nb * wb for nb, wb in plan.ell_buckets)
+    rows = ell_slots + plan.tl          # local ELL + tail
+    rows += plan.eh                     # halo-src edge gathers
+    rows += plan.k * plan.s + plan.r    # send-buffer + halo-table gathers
+    return int(2 * rows * itemsize * sum(exchange_widths(fin, widths)))
+
+
+@dataclass
+class StepCostModel:
+    """Analytic cost of ONE full-batch training step on one chip.
+
+    Per-chip figures (plan arrays are padded identically across chips, so
+    one chip's program is every chip's program; multiply by ``k`` for
+    global totals — except ``halo_send_rows``, which is already the global
+    per-exchange row count Σ(λ−1))."""
+
+    nlayers: int
+    widths: list            # exchanged/aggregated width per layer (lanes)
+    spmm_flops: int         # fwd SpMM FLOPs per chip (all layers)
+    dense_flops: int        # fwd dense-projection FLOPs per chip
+    step_flops: int         # fwd+bwd total per chip (2·spmm + 3·dense)
+    gather_bytes: int       # fwd+bwd gather-stream bytes per chip
+    halo_send_rows: int     # global boundary rows per exchange (Σ(λ−1))
+    halo_bytes_per_exchange: int   # global wire bytes per exchange
+    halo_bytes_per_step: int       # 2·L exchanges per training step
+    per_layer: list = field(default_factory=list)  # [{width, spmm_flops,
+    #   dense_flops, halo_bytes}] — the attribution table obs_report renders
+
+
+def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
+              wire_itemsize: int | None = None) -> StepCostModel:
+    """Build the cost model for one (plan, layer-stack) pair.
+
+    ``compute_dtype='bfloat16'`` halves the gather/wire itemsize (the
+    packed bf16 path); ``wire_itemsize`` overrides the wire bytes alone
+    (the ``--halo-dtype bfloat16`` wire-only lever)."""
+    from ..models.gcn import exchange_widths
+
+    itemsize = 2 if compute_dtype == "bfloat16" else 4
+    wire_b = itemsize if wire_itemsize is None else wire_itemsize
+    fs = exchange_widths(fin, list(widths))
+    dims = list(zip([fin] + list(widths)[:-1], widths))
+    nnz = int(plan.nnz.max()) if plan.nnz.size else 0
+    b = plan.b
+    send_rows = int(plan.predicted_send_volume.sum())
+
+    per_layer, spmm_f, dense_f = [], 0, 0
+    for (fi, fo), w in zip(dims, fs):
+        lf_spmm = 2 * nnz * w           # one multiply-add per (edge, lane)
+        lf_dense = 2 * b * fi * fo
+        hb = send_rows * w * wire_b
+        per_layer.append({"width": int(w), "spmm_flops": int(lf_spmm),
+                          "dense_flops": int(lf_dense), "halo_bytes": int(hb)})
+        spmm_f += lf_spmm
+        dense_f += lf_dense
+    halo_per_ex = sum(pl["halo_bytes"] for pl in per_layer) // max(
+        len(per_layer), 1)
+    return StepCostModel(
+        nlayers=len(widths),
+        widths=[int(w) for w in fs],
+        spmm_flops=int(spmm_f),
+        dense_flops=int(dense_f),
+        # symmetric bwd = one more SpMM pass; dense bwd = dX + dW ≈ 2× fwd
+        step_flops=int(2 * spmm_f + 3 * dense_f),
+        gather_bytes=int(gather_bytes_per_epoch(plan, fin, widths,
+                                                itemsize=itemsize)),
+        halo_send_rows=send_rows,
+        halo_bytes_per_exchange=int(halo_per_ex),
+        halo_bytes_per_step=int(2 * sum(pl["halo_bytes"]
+                                        for pl in per_layer)),
+        per_layer=per_layer,
+    )
+
+
+def roofline_fields(cost: StepCostModel, wall_s: float,
+                    exchanges: int = 0, exposed_exchanges: int = 0) -> dict:
+    """Join the analytic cost against ONE measured step time.
+
+    ``exchanges`` / ``exposed_exchanges`` are the step's exchange counts
+    (from ``CommStats``); ``exposed_comm_frac`` is the fraction of this
+    step's wire traffic that sat on the critical path — 1.0 in exact mode,
+    0.0 for a fully pipelined stale step, in between for a mixed window.
+    """
+    def sig(x, n=4):
+        # significant digits, not fixed decimals: a CPU-smoke step is
+        # micro-scale and a fixed round would collapse it to 0.0
+        return float(f"{x:.{n}g}")
+
+    wall_s = max(float(wall_s), 1e-12)
+    out = {
+        "gather_GB": sig(cost.gather_bytes / 1e9, 6),
+        "achieved_gather_GBs": sig(cost.gather_bytes / wall_s / 1e9),
+        "stream_ceiling_frac": sig(
+            cost.gather_bytes / wall_s / 1e9 / STREAM_CEILING_GBS),
+        "model_step_GFLOP": sig(cost.step_flops / 1e9, 6),
+        "achieved_GFLOPs": sig(cost.step_flops / wall_s / 1e9),
+        "halo_bytes_per_step": cost.halo_bytes_per_step,
+    }
+    if exchanges > 0:
+        out["exposed_comm_frac"] = round(exposed_exchanges / exchanges, 6)
+        out["exposed_halo_bytes"] = int(
+            cost.halo_bytes_per_step * exposed_exchanges / exchanges)
+    return out

@@ -1,0 +1,288 @@
+"""RunRecorder — the run manifest + append-only JSONL event stream.
+
+One ``RunRecorder`` per run directory (``--metrics-out DIR``):
+
+  * ``manifest.json``   — what ran: config, argv, git rev, backend/mesh,
+    plan digest and partitioner metadata.  Rewritten in place as late
+    facts arrive (``set_plan``/``set_backend``) — it is a small dict, and
+    a crash mid-run must still leave a parseable manifest.
+  * ``events.jsonl``    — one line per event (``step``/``eval``/``summary``
+    and recorder-side ``heartbeat``), appended and flushed per event so a
+    killed run keeps every completed step.
+  * ``heartbeat.jsonl`` — liveness pings from OTHER layers/processes
+    (``heartbeat()`` below): the launch rendezvous and the multichip
+    dryrun write here through the ``SGCN_METRICS_OUT`` env var, so an
+    operator can distinguish "slow" (heartbeats advancing) from "stalled"
+    (last heartbeat stale) without attaching a debugger.
+
+Every record is validated against ``schema`` BEFORE it is written, and
+``load_run`` re-validates on read — a run directory either loads clean or
+fails loudly.  Nothing here imports jax at module scope (the CLIs set up
+the backend env before heavy imports).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+
+from . import schema
+
+
+def _git_rev() -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            timeout=10, cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+        return out.stdout.strip() if out.returncode == 0 else None
+    except Exception:                   # noqa: BLE001 — best-effort metadata
+        return None
+
+
+def plan_digest(plan) -> str:
+    """Stable 16-hex digest of a CommPlan's comm structure — enough to tell
+    "same partition/layout" apart across runs without storing the arrays."""
+    h = hashlib.sha256()
+    h.update(repr((plan.n, plan.k, plan.b, plan.s, plan.r, plan.e,
+                   bool(plan.symmetric), tuple(plan.ell_buckets))).encode())
+    for arr in (plan.send_counts, plan.halo_counts, plan.nnz,
+                plan.part_sizes):
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+def plan_manifest_block(plan) -> dict:
+    return {
+        "n": int(plan.n), "k": int(plan.k), "b": int(plan.b),
+        "s": int(plan.s), "r": int(plan.r), "e": int(plan.e),
+        "symmetric": bool(plan.symmetric),
+        "send_rows_per_exchange": int(plan.predicted_send_volume.sum()),
+        "messages_per_exchange": int(plan.predicted_message_count.sum()),
+        "digest": plan_digest(plan),
+    }
+
+
+class RunRecorder:
+    """Owns one run directory; see module docstring."""
+
+    def __init__(self, outdir: str, config: dict | None = None,
+                 run_kind: str = "train", argv: list | None = None):
+        self.dir = outdir
+        os.makedirs(outdir, exist_ok=True)
+        self.manifest: dict = {
+            "v": schema.SCHEMA_VERSION,
+            "ts": time.time(),
+            "run_kind": run_kind,
+            "config": _jsonable(config or {}),
+            "argv": list(sys.argv if argv is None else argv),
+            "git_rev": _git_rev(),
+        }
+        self._events = open(os.path.join(outdir, schema.EVENTS_NAME), "a")
+        self._write_manifest()
+
+    # ------------------------------------------------------------- manifest
+    def _write_manifest(self) -> None:
+        schema.validate_manifest(self.manifest)
+        path = os.path.join(self.dir, schema.MANIFEST_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.manifest, fh, indent=1)
+        os.replace(tmp, path)           # atomic: never a half-written manifest
+
+    def set_plan(self, plan, partitioner: dict | None = None) -> None:
+        """Record the comm plan's identity (and the partitioner provenance
+        that produced its partvec) in the manifest."""
+        self.manifest["plan"] = plan_manifest_block(plan)
+        if partitioner is not None:
+            self.manifest["partitioner"] = _jsonable(partitioner)
+        self._write_manifest()
+
+    def set_partitioner(self, partitioner: dict) -> None:
+        """Record partitioner provenance alone (the mini-batch trainer has
+        one plan per batch, so there is no single plan block to digest)."""
+        self.manifest["partitioner"] = _jsonable(partitioner)
+        self._write_manifest()
+
+    def set_backend(self, mesh=None) -> None:
+        """Record the live jax backend + mesh (call after backend init)."""
+        import jax
+
+        self.manifest["backend"] = {
+            "platform": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "process_count": jax.process_count(),
+        }
+        if mesh is not None:
+            self.manifest["mesh"] = {
+                "axes": {str(k): int(v)
+                         for k, v in mesh.shape.items()},
+            }
+        self._write_manifest()
+
+    # --------------------------------------------------------------- events
+    def _emit(self, ev: dict) -> None:
+        ev.setdefault("v", schema.SCHEMA_VERSION)
+        ev.setdefault("ts", time.time())
+        ev = _jsonable(ev)
+        schema.validate_event(ev)
+        self._events.write(json.dumps(ev) + "\n")
+        self._events.flush()
+
+    def record_step(self, step: int, loss: float, wall_s: float,
+                    err: float | None = None, grad_norm: float | None = None,
+                    comm: dict | None = None, phases: dict | None = None,
+                    roofline: dict | None = None, drift: dict | None = None,
+                    **extra) -> None:
+        ev = {"kind": "step", "step": int(step), "loss": float(loss),
+              "wall_s": float(wall_s)}
+        if err is not None:
+            ev["err"] = float(err)
+        if grad_norm is not None:
+            ev["grad_norm"] = float(grad_norm)
+        for k, val in (("comm", comm), ("phases", phases),
+                       ("roofline", roofline), ("drift", drift)):
+            if val is not None:
+                ev[k] = val
+        ev.update(extra)
+        self._emit(ev)
+
+    def record_eval(self, step: int, loss: float, acc: float | None = None,
+                    wall_s: float | None = None) -> None:
+        ev = {"kind": "eval", "step": int(step), "loss": float(loss)}
+        if acc is not None:
+            ev["acc"] = float(acc)
+        if wall_s is not None:
+            ev["wall_s"] = float(wall_s)
+        self._emit(ev)
+
+    def record_heartbeat(self, event: str, **fields) -> None:
+        self._emit({"kind": "heartbeat", "event": str(event),
+                    "pid": os.getpid(), **fields})
+
+    def record_summary(self, report: dict) -> None:
+        """End-of-run report (the trainer's ``fit()`` dict, the bench JSON)."""
+        self._emit({"kind": "summary", "report": _jsonable(report)})
+
+    def close(self) -> None:
+        self._events.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------- heartbeat
+def heartbeat(event: str, **fields) -> None:
+    """Append a liveness ping to ``$SGCN_METRICS_OUT/heartbeat.jsonl``.
+
+    No-op unless the env var names a directory — callers sprinkle these at
+    phase boundaries unconditionally (launch rendezvous, multichip dryrun)
+    and pay nothing when telemetry is off.  Best-effort by design: a
+    full disk must not kill the training run it is observing.
+    """
+    outdir = os.environ.get("SGCN_METRICS_OUT")
+    if not outdir:
+        return
+    ev = {"v": schema.SCHEMA_VERSION, "ts": time.time(), "kind": "heartbeat",
+          "event": str(event), "pid": os.getpid(), **fields}
+    try:
+        schema.validate_event(ev)
+        os.makedirs(outdir, exist_ok=True)
+        with open(os.path.join(outdir, schema.HEARTBEAT_NAME), "a") as fh:
+            fh.write(json.dumps(_jsonable(ev)) + "\n")
+    except (OSError, ValueError):
+        pass
+
+
+# -------------------------------------------------------------------- loader
+@dataclass
+class RunLog:
+    path: str
+    manifest: dict
+    events: list          # validated events.jsonl records, in write order
+    heartbeats: list      # validated heartbeat.jsonl records (may be empty)
+
+    def steps(self) -> list:
+        return [e for e in self.events if e["kind"] == "step"]
+
+    def evals(self) -> list:
+        return [e for e in self.events if e["kind"] == "eval"]
+
+    def summaries(self) -> list:
+        return [e for e in self.events if e["kind"] == "summary"]
+
+
+def load_run(path: str) -> RunLog:
+    """Load + validate one run directory.  Raises on schema violations —
+    a telemetry consumer must never silently chart garbage.
+
+    A directory holding ONLY ``heartbeat.jsonl`` is valid: the launch/dryrun
+    layers write heartbeats through ``$SGCN_METRICS_OUT`` without a
+    ``RunRecorder`` (no manifest), and the "slow vs stalled" signal must be
+    loadable from exactly that.  ``manifest`` is then ``{}``."""
+    mpath = os.path.join(path, schema.MANIFEST_NAME)
+    if os.path.exists(mpath):
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+        schema.validate_manifest(manifest)
+    elif os.path.exists(os.path.join(path, schema.HEARTBEAT_NAME)):
+        manifest = {}
+    else:
+        raise FileNotFoundError(
+            f"{path}: neither {schema.MANIFEST_NAME} nor "
+            f"{schema.HEARTBEAT_NAME} — not a run directory")
+
+    def read_jsonl(name):
+        p = os.path.join(path, name)
+        if not os.path.exists(p):
+            return []
+        out = []
+        with open(p) as fh:
+            for i, line in enumerate(fh):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f"{p}:{i + 1}: not valid JSON ({e})") from e
+                schema.validate_event(ev)
+                out.append(ev)
+        return out
+
+    return RunLog(path=path, manifest=manifest,
+                  events=read_jsonl(schema.EVENTS_NAME),
+                  heartbeats=read_jsonl(schema.HEARTBEAT_NAME))
+
+
+def _jsonable(x):
+    """Coerce numpy scalars/arrays and other non-JSON leaves to JSON types."""
+    import numpy as np
+
+    if isinstance(x, dict):
+        return {str(k): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, np.ndarray):
+        return [_jsonable(v) for v in x.tolist()]
+    if isinstance(x, (np.integer,)):
+        return int(x)
+    if isinstance(x, (np.floating,)):
+        return float(x)
+    if isinstance(x, (str, int, float, bool)) or x is None:
+        return x
+    # jax arrays and anything else scalar-like: try float, else repr
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return repr(x)

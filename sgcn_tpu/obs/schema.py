@@ -1,0 +1,146 @@
+"""Run-telemetry schema — the versioned vocabulary of the JSONL event stream.
+
+Everything the subsystem writes (``manifest.json``, ``events.jsonl``,
+``heartbeat.jsonl``) is validated against THIS module before it hits disk
+(``recorder.RunRecorder``) and again on load (``recorder.load_run``), so a
+run directory is machine-checkable end to end.  The field glossary lives in
+``docs/observability.md``; this module is the executable form.
+
+Design rules:
+
+  * every record carries ``v`` (schema version) and ``ts`` (unix seconds);
+    events additionally carry ``kind``;
+  * required fields are typed; optional fields are typed WHEN present —
+    unknown extra fields are allowed (forward compatibility), unknown
+    ``kind`` values are not;
+  * numeric health: wall-clock and step-index fields must be finite — a
+    NaN wall time is always a recorder bug, while ``loss`` may be non-finite
+    (a diverged run is exactly what telemetry must be able to show).
+
+Bump ``SCHEMA_VERSION`` on any breaking field change and teach
+``load_run``/``scripts/obs_report.py`` both versions for one release.
+"""
+
+from __future__ import annotations
+
+import math
+import numbers
+
+SCHEMA_VERSION = 1
+
+# event stream file names inside a run directory
+MANIFEST_NAME = "manifest.json"
+EVENTS_NAME = "events.jsonl"
+HEARTBEAT_NAME = "heartbeat.jsonl"
+
+EVENT_KINDS = ("step", "eval", "heartbeat", "summary")
+
+_NUM = numbers.Real
+_STR = str
+
+# kind -> {field: type} (required)
+_REQUIRED = {
+    "step": {"step": _NUM, "loss": _NUM, "wall_s": _NUM},
+    "eval": {"step": _NUM, "loss": _NUM},
+    "heartbeat": {"event": _STR},
+    "summary": {"report": dict},
+}
+
+# kind -> {field: type} (optional, typed when present)
+_OPTIONAL = {
+    "step": {
+        "err": _NUM,          # the MPI stack's `err` metric (loss='bce')
+        "grad_norm": _NUM,    # global L2 norm of the psum'd weight grads
+        "comm": dict,         # cumulative CommStats.report() snapshot
+        "phases": dict,       # PhaseTimer.report() snapshot
+        "roofline": dict,     # attribution.roofline_fields output
+        "drift": dict,        # stale-halo drift gauges (see below)
+        "epoch": _NUM,
+        "batch": _NUM,        # mini-batch trainer: batch index within epoch
+    },
+    "eval": {"acc": _NUM, "wall_s": _NUM},
+    "heartbeat": {"pid": _NUM, "phase": _STR, "detail": _STR},
+    "summary": {},
+}
+
+# comm snapshot: the CommStats.report() keys every step event must reconcile
+# (hidden + exposed == total — asserted by tests/test_metrics_cli.py)
+COMM_SPLIT_KEYS = ("exchanges", "exposed_exchanges", "hidden_exchanges",
+                   "exposed_send_volume", "hidden_send_volume",
+                   "total_send_volume")
+
+# drift-gauge fields (stale mode only): the AUTHORITATIVE field list —
+# ``validate_event`` requires every one of these in a step event's ``drift``
+# block, so this tuple, the trainer's ``_drift_fields`` and the
+# docs/observability.md glossary cannot drift apart
+DRIFT_KEYS = ("staleness_age", "sync_step", "halo_drift_rms",
+              "halo_drift_rel", "halo_quant_err_rms")
+
+_MANIFEST_REQUIRED = {"v": _NUM, "ts": _NUM, "run_kind": _STR, "config": dict}
+_MANIFEST_OPTIONAL = {
+    "argv": list, "git_rev": (str, type(None)), "backend": dict,
+    "mesh": dict, "plan": dict, "partitioner": (dict, type(None)),
+}
+
+
+def _check_fields(rec: dict, required: dict, optional: dict, what: str) -> None:
+    for f, t in required.items():
+        if f not in rec:
+            raise ValueError(f"{what}: missing required field {f!r}: {rec}")
+        if not isinstance(rec[f], t) or isinstance(rec[f], bool) and t is _NUM:
+            raise ValueError(
+                f"{what}: field {f!r} has type {type(rec[f]).__name__}, "
+                f"expected {t}")
+    for f, t in optional.items():
+        if f in rec and rec[f] is not None and not isinstance(rec[f], t):
+            raise ValueError(
+                f"{what}: optional field {f!r} has type "
+                f"{type(rec[f]).__name__}, expected {t}")
+
+
+def validate_event(ev: dict) -> None:
+    """Raise ``ValueError`` unless ``ev`` is a valid schema-v1 event."""
+    if not isinstance(ev, dict):
+        raise ValueError(f"event must be a dict, got {type(ev).__name__}")
+    kind = ev.get("kind")
+    if kind not in EVENT_KINDS:
+        raise ValueError(f"unknown event kind {kind!r} (know {EVENT_KINDS})")
+    if ev.get("v") != SCHEMA_VERSION:
+        raise ValueError(
+            f"event schema version {ev.get('v')!r} != {SCHEMA_VERSION}")
+    if not isinstance(ev.get("ts"), _NUM):
+        raise ValueError(f"event missing numeric ts: {ev}")
+    _check_fields(ev, _REQUIRED[kind], _OPTIONAL[kind], f"{kind} event")
+    # wall-clock / index health: a NaN here is a recorder bug, not a run fact
+    for f in ("step", "wall_s", "epoch", "batch"):
+        if f in ev and isinstance(ev[f], _NUM) and not math.isfinite(ev[f]):
+            raise ValueError(f"{kind} event: non-finite {f}={ev[f]}")
+    if kind == "step" and "comm" in ev and ev["comm"] is not None:
+        comm = ev["comm"]
+        missing = [k for k in COMM_SPLIT_KEYS if k not in comm]
+        if missing:
+            raise ValueError(
+                f"step event comm snapshot missing {missing} "
+                "(must be a full CommStats.report())")
+        if (comm["exposed_exchanges"] + comm["hidden_exchanges"]
+                != comm["exchanges"]):
+            raise ValueError(
+                "step event comm snapshot violates the hidden/exposed "
+                f"split: {comm['exposed_exchanges']} + "
+                f"{comm['hidden_exchanges']} != {comm['exchanges']}")
+    if kind == "step" and ev.get("drift") is not None:
+        missing = [k for k in DRIFT_KEYS if k not in ev["drift"]]
+        if missing:
+            raise ValueError(
+                f"step event drift block missing {missing} "
+                f"(must carry every DRIFT_KEYS field)")
+
+
+def validate_manifest(m: dict) -> None:
+    """Raise ``ValueError`` unless ``m`` is a valid schema-v1 manifest."""
+    if not isinstance(m, dict):
+        raise ValueError(f"manifest must be a dict, got {type(m).__name__}")
+    if m.get("v") != SCHEMA_VERSION:
+        raise ValueError(
+            f"manifest schema version {m.get('v')!r} != {SCHEMA_VERSION}")
+    _check_fields(m, _MANIFEST_REQUIRED, _MANIFEST_OPTIONAL, "manifest")

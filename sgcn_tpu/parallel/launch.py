@@ -90,23 +90,34 @@ def init_distributed(coordinator: str | None = None,
     Resolution order: explicit args → Cloud TPU autodetection (no env needed)
     → SLURM env (reference-style cluster).
     """
+    from ..obs.recorder import heartbeat   # no-op unless SGCN_METRICS_OUT
+
     if num_processes is None:
         env = slurm_rendezvous_env()
         if env is not None:
             coordinator, num_processes, process_id = env
     if num_processes is not None and num_processes > 1:
+        # heartbeats bracket the rendezvous: a pod whose coordinator never
+        # comes up looks IDENTICAL to a slow compile from the driver's seat
+        # — the last heartbeat's phase tells them apart (docs/observability.md)
+        heartbeat("rendezvous:start", phase="init_distributed",
+                  detail=f"{num_processes} processes @ {coordinator}")
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id,
         )
+        heartbeat("rendezvous:done", phase="init_distributed")
     elif num_processes is None:
         # Cloud TPU pod: fully autodetected — only when there genuinely are
         # multiple workers (single-worker boxes also set TPU_WORKER_HOSTNAMES)
         hosts = [h for h in os.environ.get(
             "TPU_WORKER_HOSTNAMES", "").split(",") if h]
         if len(hosts) > 1:
+            heartbeat("rendezvous:start", phase="init_distributed",
+                      detail=f"TPU pod autodetect, {len(hosts)} hosts")
             jax.distributed.initialize()
+            heartbeat("rendezvous:done", phase="init_distributed")
     return DistributedContext(
         process_id=jax.process_index(),
         num_processes=jax.process_count(),

@@ -104,6 +104,14 @@ def main() -> None:
                         "reference's analogue is its manual phase timers, "
                         "Cagnet/main.c:35-38 — see utils/timers.py for "
                         "those)")
+    p.add_argument("--metrics-out", default=None, metavar="DIR",
+                   help="run-telemetry directory (sgcn_tpu.obs): writes a "
+                        "run manifest (config, git rev, plan digest) plus a "
+                        "per-step JSONL event stream — loss, grad-norm, "
+                        "wall time, the hidden/exposed comm split, roofline "
+                        "attribution and (stale mode) drift gauges; render "
+                        "with scripts/obs_report.py, schema in "
+                        "docs/observability.md")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -133,6 +141,12 @@ def main() -> None:
             "--halo-delta/--sync-every configure the stale pipelined "
             "exchange; add --halo-staleness 1")
 
+    if args.metrics_out:
+        # before any heavy import: heartbeat() in the launch/backend layers
+        # reads this env var, so rendezvous pings land in the run directory
+        import os
+        os.environ["SGCN_METRICS_OUT"] = args.metrics_out
+
     from ..utils.backend import enable_tpu_async_collectives, use_cpu_devices
     if args.backend == "cpu":
         use_cpu_devices(args.nparts)
@@ -142,6 +156,14 @@ def main() -> None:
 
     from ..parallel.launch import init_distributed
     ctx = init_distributed()   # no-op single-process; SLURM/TPU-pod rendezvous otherwise
+
+    recorder = None
+    if args.metrics_out and ctx.is_coordinator:
+        # rank-0-only, like every other end-of-run artifact (the reference
+        # prints rank-0 stats; multi-host ranks share the filesystem)
+        from ..obs import RunRecorder
+        recorder = RunRecorder(args.metrics_out, config=vars(args))
+        recorder.set_backend()
 
     import numpy as np
 
@@ -226,6 +248,11 @@ def main() -> None:
                 seed=args.seed)
         report["experiment"] = "accuracy"
         report["backend"] = args.backend
+        if recorder is not None:
+            # the parity harness drives its own trainers; record the run's
+            # identity + outcome (no per-step stream for this experiment)
+            recorder.record_summary(report)
+            recorder.close()
         if ctx.is_coordinator:
             print(json.dumps(report), flush=True)
         return
@@ -237,6 +264,9 @@ def main() -> None:
                                   model=args.model, loss=args.loss,
                                   activation=activation, seed=args.seed,
                                   compute_dtype=args.dtype)
+            if recorder is not None:
+                recorder.set_partitioner({"partvec": args.partvec, "k": k})
+                tr.attach_recorder(recorder)
             state = tr.inner          # checkpointable params/opt_state holder
             start_step = 0
             if args.resume:
@@ -254,6 +284,11 @@ def main() -> None:
                                   halo_staleness=args.halo_staleness,
                                   halo_delta=args.halo_delta,
                                   sync_every=args.sync_every)
+            if recorder is not None:
+                recorder.set_plan(plan, partitioner={"partvec": args.partvec,
+                                                     "k": k})
+                recorder.set_backend(tr.mesh)
+                tr.attach_recorder(recorder)
             state = tr
             start_step = 0
             if args.resume:
@@ -278,6 +313,8 @@ def main() -> None:
     report["activation"] = activation
     report["loss"] = args.loss
     report.pop("loss_history", None)
+    if recorder is not None:
+        recorder.close()
     if ctx.is_coordinator:
         print(json.dumps(report), flush=True)
 

@@ -45,6 +45,7 @@ from ..models.gcn import (
 from ..parallel.mesh import AXIS, make_mesh_1d, replicate, shard_stacked
 from ..parallel.plan import CommPlan
 from ..utils.stats import CommStats
+from ..utils.timers import PhaseTimer
 
 # model registry: name → (param init, per-chip forward, plan→fields shipped
 # to the device). GAT is the reference's PGAT capability (GPU/PGAT.py) on the
@@ -172,6 +173,14 @@ def _reblock(tree):
     return jax.tree.map(lambda x: x[None], tree)
 
 
+def _global_grad_norm(grads):
+    """L2 norm over every leaf of an (already psum'd, replicated) grad tree."""
+    import jax.numpy as jnp
+
+    sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
+    return jnp.sqrt(sq)
+
+
 class FullBatchTrainer:
     """Distributed full-batch trainer (PGCN-equivalent, ``-b jax`` backend)."""
 
@@ -272,6 +281,18 @@ class FullBatchTrainer:
         self.sync_every = sync_every
         self.halo_dtype = halo_dtype
         self.plan = plan
+        self.fin = fin
+        self.widths = list(widths)
+        # run telemetry (sgcn_tpu.obs): attach_recorder() compiles the
+        # telemetry step variants; until then the recorder is off and every
+        # code path below is the pre-existing trainer
+        self.recorder = None
+        self.timer = PhaseTimer()   # CAGNET-vocabulary phase breakdown —
+        # the ONE code path for phase boundaries (fit()'s wall-clock and the
+        # JSONL phase records both read it; sync= callables sit at each
+        # block_until_ready boundary)
+        self._step_count = 0
+        self._cost = None           # lazy obs.attribution.step_cost model
         self.mesh = mesh if mesh is not None else make_mesh_1d(plan.k)
         self.activation = activation
         self.final_activation = final_activation
@@ -345,6 +366,7 @@ class FullBatchTrainer:
             }
             self.halo_carry = shard_stacked(self.mesh, carry)
             self._stale_step_idx = 0
+            self._last_sync_idx = 0     # staleness-age gauge anchor
             self._step_stale = self._build_step_stale(fresh=False)
             self._step_sync = self._build_step_stale(fresh=True)
             self._multi_stale = {}   # epochs -> compiled stale epoch loop
@@ -370,8 +392,13 @@ class FullBatchTrainer:
         )
         return out.astype("float32")
 
-    def _one_step(self, params, opt_state, pa, h0, labels, valid):
-        """One per-chip training step (shared by _build_step/_build_multi)."""
+    def _one_step(self, params, opt_state, pa, h0, labels, valid,
+                  telemetry: bool = False):
+        """One per-chip training step (shared by _build_step/_build_multi).
+
+        ``telemetry=True`` (the program compiled by ``attach_recorder``)
+        additionally returns the global L2 norm of the psum'd weight grads
+        — already replicated, so it costs one reduce of each grad leaf."""
         fwd = (jax.checkpoint(self._forward, static_argnums=())
                if self.remat else self._forward)
 
@@ -388,14 +415,17 @@ class FullBatchTrainer:
         grads = jax.tree.map(lambda g: lax.psum(g, AXIS), grads)
         updates, opt_state = self.opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if telemetry:
+            gnorm = _global_grad_norm(grads)
+            return params, opt_state, loss, err, gnorm
         return params, opt_state, loss, err
 
     # ------------------------------------------------------- stale pipelining
     def _forward_stale(self, params, pa, h0, halos, ghalos, bases,
-                       fresh: bool):
+                       fresh: bool, gauges: bool = False):
         from ..models.gcn import gcn_forward_local_stale
 
-        out, nh, nb = gcn_forward_local_stale(
+        out = gcn_forward_local_stale(
             params, h0, pa, halos, ghalos, bases,
             activation=self.activation,
             final_activation=self.final_activation,
@@ -406,51 +436,89 @@ class FullBatchTrainer:
             wire_dtype="bfloat16" if self.halo_delta else self.halo_dtype,
             gwire_dtype=self.halo_dtype,
             fresh=fresh,
+            gauges=gauges,
         )
-        return out.astype("float32"), nh, nb
+        if gauges:
+            logits, nh, nb, qe = out
+            return logits.astype("float32"), nh, nb, qe
+        logits, nh, nb = out
+        return logits.astype("float32"), nh, nb
 
     def _one_step_stale(self, params, opt_state, carry, pa, h0, labels,
-                        valid, fresh: bool):
+                        valid, fresh: bool, telemetry: bool = False):
         """One per-chip training step under the pipelined stale exchange.
 
         The gradient-halo carries ride jax's cotangent machinery: the loss
         is differentiated w.r.t. ``(params, ghalos)`` and ``pspmm_stale``'s
         custom VJP returns, as the "gradient" of each ``ghalos[ℓ]``, the
         FRESH gradient exchange that becomes next step's carry.
+
+        ``telemetry=True`` additionally returns ``(gnorm, gauges)`` — the
+        drift gauges of the stale mode (``docs/observability.md``), all
+        psum'd to global scalars so they come back replicated:
+
+          * ``drift_sq[ℓ]``  — ``Σ (halo_next − halo_in)²``: the fresh
+            exchange against the stale carry the step actually consumed —
+            the per-layer ‖stale − fresh‖² proxy, available EVERY step
+            (on a full-sync step it measures the drift the sync erased);
+          * ``ref_sq[ℓ]``    — ``Σ halo_next²``, the normalizer for a
+            relative drift figure;
+          * ``qerr_sq[ℓ]``   — this step's halo-delta wire quantization
+            residual ``Σ (full − base_next)²`` (zero without ``--halo-delta``).
         """
         halos, ghalos, bases = carry["halos"], carry["ghalos"], carry["bases"]
 
         def loss_fn(ps, gh):
-            logits, nh, nb = self._forward_stale(
-                ps, pa, h0, halos, gh, bases, fresh)
+            if telemetry:
+                logits, nh, nb, qe = self._forward_stale(
+                    ps, pa, h0, halos, gh, bases, fresh, gauges=True)
+            else:
+                logits, nh, nb = self._forward_stale(
+                    ps, pa, h0, halos, gh, bases, fresh)
+                qe = None
             loss = self._loss_fn(logits, labels, valid)
             err = (masked_err_local(logits, labels, valid)
                    if self.loss_name == "bce" else loss)
-            return loss, (err, nh, nb)
+            return loss, (err, nh, nb, qe)
 
-        (loss, (err, nh, nb)), (grads, ngh) = jax.value_and_grad(
+        (loss, (err, nh, nb, qe)), (grads, ngh) = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True)(params, ghalos)
         # weight grads are global partial sums (exact mode's psum); the halo
         # carries are PER-CHIP state — never reduced
         grads = jax.tree.map(lambda g: lax.psum(g, AXIS), grads)
         updates, opt_state = self.opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        carry = {"halos": nh, "ghalos": list(ngh), "bases": nb}
-        return params, opt_state, carry, loss, err
+        new_carry = {"halos": nh, "ghalos": list(ngh), "bases": nb}
+        if not telemetry:
+            return params, opt_state, new_carry, loss, err
+        import jax.numpy as jnp
+        gauges = {
+            "drift_sq": jnp.stack([
+                lax.psum(jnp.sum(jnp.square(n - o)), AXIS)
+                for n, o in zip(nh, halos)]),
+            "ref_sq": jnp.stack([
+                lax.psum(jnp.sum(jnp.square(n)), AXIS) for n in nh]),
+            "qerr_sq": jnp.stack([lax.psum(q, AXIS) for q in qe]),
+        }
+        return (params, opt_state, new_carry, loss, err,
+                _global_grad_norm(grads), gauges)
 
-    def _build_step_stale(self, fresh: bool):
+    def _build_step_stale(self, fresh: bool, telemetry: bool = False):
         def per_chip(params, opt_state, carry, pa, h0, labels, valid):
             carry, pa, h0, labels, valid = _unblock(
                 (carry, pa, h0, labels, valid))
-            params, opt_state, carry, loss, err = self._one_step_stale(
-                params, opt_state, carry, pa, h0, labels, valid, fresh)
-            return params, opt_state, _reblock(carry), loss, err
+            out = self._one_step_stale(
+                params, opt_state, carry, pa, h0, labels, valid, fresh,
+                telemetry=telemetry)
+            params, opt_state, carry = out[:3]
+            return (params, opt_state, _reblock(carry)) + out[3:]
 
         smapped = jax.shard_map(
             per_chip,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
-            out_specs=(P(), P(), P(AXIS), P(), P()),
+            out_specs=(P(), P(), P(AXIS), P(), P()) + ((P(), P())
+                                                       if telemetry else ()),
         )
         return jax.jit(smapped, donate_argnums=(0, 1, 2))
 
@@ -491,27 +559,46 @@ class FullBatchTrainer:
             self._stale_step_idx % self.sync_every == 0
 
     def _stale_run_one(self, data: TrainData):
-        """One stale-mode optimizer step (sync or pipelined per schedule)."""
+        """One stale-mode optimizer step (sync or pipelined per schedule).
+
+        With a recorder attached the telemetry programs run instead and the
+        drift gauges ride along: returns ``(loss, err, extra)`` where
+        ``extra`` is ``(gnorm, gauges, staleness_age, sync_step)`` under
+        telemetry, else ``None``."""
         sync_step = self._stale_sync_due()
-        prog = self._step_sync if sync_step else self._step_stale
-        (self.params, self.opt_state, self.halo_carry, loss, err) = prog(
-            self.params, self.opt_state, self.halo_carry, self.pa,
-            data.h0, data.labels, data.train_valid,
-        )
+        age = self._stale_step_idx - self._last_sync_idx
+        if self.recorder is not None:
+            prog = self._step_sync_tel if sync_step else self._step_stale_tel
+            (self.params, self.opt_state, self.halo_carry, loss, err, gnorm,
+             gauges) = prog(
+                self.params, self.opt_state, self.halo_carry, self.pa,
+                data.h0, data.labels, data.train_valid,
+            )
+            extra = (gnorm, gauges, age, sync_step)
+        else:
+            prog = self._step_sync if sync_step else self._step_stale
+            (self.params, self.opt_state, self.halo_carry, loss, err) = prog(
+                self.params, self.opt_state, self.halo_carry, self.pa,
+                data.h0, data.labels, data.train_valid,
+            )
+            extra = None
+        if sync_step:
+            self._last_sync_idx = self._stale_step_idx
         self._stale_step_idx += 1
         self.stats.count_step(nlayers=self.nlayers, hidden=not sync_step)
-        return loss, err
+        return loss, err, extra
 
-    def _build_step(self, mesh=None):
+    def _build_step(self, mesh=None, telemetry: bool = False):
         def per_chip(params, opt_state, pa, h0, labels, valid):
             pa, h0, labels, valid = _unblock((pa, h0, labels, valid))
-            return self._one_step(params, opt_state, pa, h0, labels, valid)
+            return self._one_step(params, opt_state, pa, h0, labels, valid,
+                                  telemetry=telemetry)
 
         smapped = jax.shard_map(
             per_chip,
             mesh=mesh if mesh is not None else self.mesh,
             in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
-            out_specs=(P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P()) + ((P(),) if telemetry else ()),
         )
         return jax.jit(smapped, donate_argnums=(0, 1))
 
@@ -594,7 +681,16 @@ class FullBatchTrainer:
 
         Stale mode runs the same on-device loop over PIPELINED steps, with
         the full-sync steps (carry init + every ``sync_every``-th step)
-        dispatched individually around the loop segments."""
+        dispatched individually around the loop segments.
+
+        With a recorder attached, epochs dispatch as individual ``step()``
+        calls so each emits its JSONL event — per-step observability is
+        exactly what the fused loop cannot provide (documented trade;
+        ``attach_recorder``)."""
+        if self.recorder is not None:
+            losses = np.asarray([self.step(data) for _ in range(epochs)],
+                                np.float32)
+            return losses
         if self.halo_staleness:
             return self._run_epochs_stale(data, epochs, sync)
         if epochs not in self._multi:
@@ -615,7 +711,7 @@ class FullBatchTrainer:
         left = epochs
         while left > 0:
             if self._stale_sync_due():
-                loss, err = self._stale_run_one(data)
+                loss, err, _ = self._stale_run_one(data)
                 parts.append(jnp.reshape(loss, (1,)))
                 err_parts.append(jnp.reshape(err, (1,)))
                 left -= 1
@@ -665,6 +761,73 @@ class FullBatchTrainer:
         )
         return jax.jit(smapped)
 
+    # ------------------------------------------------------ run telemetry
+    def attach_recorder(self, recorder) -> None:
+        """Attach a ``sgcn_tpu.obs.RunRecorder``: compiles telemetry step
+        variants (grad-norm out; drift gauges in stale mode) and switches
+        ``step``/``run_epochs`` to per-step event emission.  ``run_epochs``
+        then dispatches one program per step instead of the fused on-device
+        epoch loop — per-step wall times and loss readbacks are exactly what
+        the fused loop cannot surface; detach (``recorder=None``) to get the
+        one-dispatch path back."""
+        self.recorder = recorder
+        self._step_tel = self._build_step(telemetry=True)
+        if self.halo_staleness:
+            self._step_stale_tel = self._build_step_stale(
+                fresh=False, telemetry=True)
+            self._step_sync_tel = self._build_step_stale(
+                fresh=True, telemetry=True)
+
+    def _record_step_event(self, loss: float, err, gnorm, wall_s: float,
+                           drift: dict | None) -> None:
+        from ..obs.attribution import roofline_fields, step_cost
+
+        roofline = None
+        # same honesty gate as bench.py: the gather model describes the
+        # bucketed-ELL GCN aggregator — for GAT (attention-table exchange)
+        # or the Pallas VMEM kernel it would describe a program that didn't
+        # run, so omit it rather than mislead
+        if self.model == "gcn" and "pallas_tb" not in self._fwd_static:
+            if self._cost is None:
+                self._cost = step_cost(
+                    self.plan, self.fin, self.widths,
+                    compute_dtype=self.compute_dtype,
+                    wire_itemsize=2 if (self.halo_dtype == "bfloat16"
+                                        or self.halo_delta) else None)
+            ex_step = 2 * self.nlayers      # this step's exchanges
+            exposed_step = 0 if (drift is not None
+                                 and not drift.get("sync_step")) else ex_step
+            roofline = roofline_fields(self._cost, wall_s,
+                                       exchanges=ex_step,
+                                       exposed_exchanges=exposed_step)
+        self.recorder.record_step(
+            step=self._step_count, loss=loss, wall_s=wall_s,
+            err=float(err) if self.loss_name == "bce" else None,
+            grad_norm=float(gnorm) if gnorm is not None else None,
+            comm=self.stats.report(),
+            phases=self.timer.report() or None,
+            drift=drift,
+            roofline=roofline,
+        )
+
+    @staticmethod
+    def _drift_fields(gauges: dict, age: int, sync_step: bool) -> dict:
+        """Host-side rendering of the in-graph gauge scalars (see
+        ``_one_step_stale``) into the schema's drift block."""
+        import numpy as np
+
+        d = np.sqrt(np.maximum(np.asarray(gauges["drift_sq"], np.float64), 0))
+        r = np.sqrt(np.maximum(np.asarray(gauges["ref_sq"], np.float64), 0))
+        q = np.sqrt(np.maximum(np.asarray(gauges["qerr_sq"], np.float64), 0))
+        return {
+            "staleness_age": int(age),
+            "sync_step": bool(sync_step),
+            "halo_drift_rms": [float(x) for x in d],
+            "halo_drift_rel": [float(x / max(y, 1e-30))
+                               for x, y in zip(d, r)],
+            "halo_quant_err_rms": [float(x) for x in q],
+        }
+
     # ------------------------------------------------------------------- api
     def step(self, data: TrainData, sync: bool = True):
         """One training step.  ``sync=True`` (default) blocks on the loss
@@ -672,25 +835,58 @@ class FullBatchTrainer:
         loss print implies (``GPU/PGCN.py:223-224``).  ``sync=False`` returns
         the on-device loss array so callers can pipeline many steps and pay
         one host round-trip at the end (the tunneled dev chip has ~90 ms
-        round-trip latency that would otherwise swamp epoch timings)."""
+        round-trip latency that would otherwise swamp epoch timings).
+
+        With a recorder attached, every step additionally appends one JSONL
+        event (loss, grad-norm, wall time, cumulative comm split, roofline
+        attribution, stale-mode drift gauges) — the readback this implies
+        makes ``sync=False`` behave like ``sync=True`` for timing purposes."""
+        t0 = time.perf_counter()
         if self.halo_staleness:
-            loss, err = self._stale_run_one(data)
+            loss, err, extra = self._stale_run_one(data)
             self.last_err = err
+            self._step_count += 1
+            if self.recorder is not None:
+                gnorm, gauges, age, sync_step = extra
+                loss = float(loss)
+                self._record_step_event(
+                    loss, err, gnorm, time.perf_counter() - t0,
+                    drift=self._drift_fields(gauges, age, sync_step))
             return float(loss) if sync else loss
+        if self.recorder is not None:
+            self.params, self.opt_state, loss, err, gnorm = self._step_tel(
+                self.params, self.opt_state, self.pa, data.h0, data.labels,
+                data.train_valid,
+            )
+            self.last_err = err
+            self.stats.count_step(nlayers=self.nlayers)
+            self._step_count += 1
+            loss = float(loss)
+            self._record_step_event(loss, err, gnorm,
+                                    time.perf_counter() - t0, drift=None)
+            return loss
         self.params, self.opt_state, loss, err = self._step(
             self.params, self.opt_state, self.pa, data.h0, data.labels,
             data.train_valid,
         )
         self.last_err = err   # the MPI stack's `err` metric under loss='bce'
         self.stats.count_step(nlayers=self.nlayers)
+        self._step_count += 1
         return float(loss) if sync else loss
 
     def evaluate(self, data: TrainData) -> tuple[float, float]:
-        loss, acc, _ = self._eval(
-            self.params, self.pa, data.h0, data.labels, data.eval_valid
-        )
+        t0 = time.perf_counter()
+        with self.timer.phase("eval"):
+            loss, acc, _ = self._eval(
+                self.params, self.pa, data.h0, data.labels, data.eval_valid
+            )
+            loss, acc = float(loss), float(acc)
         self.stats.count_forward(nlayers=self.nlayers)
-        return float(loss), float(acc)
+        if self.recorder is not None:
+            self.recorder.record_eval(step=self._step_count, loss=loss,
+                                      acc=acc,
+                                      wall_s=time.perf_counter() - t0)
+        return loss, acc
 
     def predict(self, data: TrainData) -> np.ndarray:
         """Global (n, nout) logits in original vertex order."""
@@ -712,28 +908,39 @@ class FullBatchTrainer:
         verbose: bool = True,
     ) -> dict:
         """Epoch loop with reference-style timing: ``warmup`` untimed epochs,
-        then wall-clock over the timed ones (``GPU/PGCN.py:202-228``)."""
+        then wall-clock over the timed ones (``GPU/PGCN.py:202-228``).
+
+        Phase boundaries route through ``self.timer`` (the CAGNET-vocabulary
+        ``PhaseTimer``) with a ``sync=`` callable at each block_until_ready
+        boundary — the SAME accounting the per-step JSONL events snapshot,
+        so ``report()['phases']`` and the event stream cannot disagree
+        (previously the boundaries were raw ``perf_counter`` reads that
+        never reached the timer)."""
         data = TrainData(**shard_stacked(self.mesh, vars(data)))
         history: list[float] = []
-        for _ in range(warmup):
-            self.step(data)
-        jax.block_until_ready(self.params)
-        t0 = time.perf_counter()
+        t_prior = self.timer.totals["train_step"]   # fit() may be re-entered
+        with self.timer.phase("warmup", sync=lambda: self.params):
+            for _ in range(warmup):
+                self.step(data)
         for ep in range(epochs):
-            loss = self.step(data)
+            with self.timer.phase("train_step", sync=lambda: self.params):
+                loss = self.step(data)
             history.append(loss)
             if verbose:
                 print(f"epoch {ep}: loss {loss:.6f}", flush=True)
-        jax.block_until_ready(self.params)
-        elapsed = time.perf_counter() - t0
+        elapsed = self.timer.totals["train_step"] - t_prior
         report = self.stats.report()
         report.update(
             epochs=epochs,
             elapsed_s=elapsed,
             epoch_s=elapsed / max(epochs, 1),
             loss_history=history,
+            phases=self.timer.report(),
         )
         if self.loss_name == "bce":
             # rank-0 err line of the MPI stack (Parallel-GCN/main.c:322-323)
             report["err"] = float(self.last_err)
+        if self.recorder is not None:
+            self.recorder.record_summary(
+                {k: v for k, v in report.items() if k != "loss_history"})
         return report

@@ -135,6 +135,47 @@ class MiniBatchTrainer:
             compute_dtype=compute_dtype)
         self.nlayers = len(widths)
         self._fullgraph_eval = None   # built lazily, cached across calls
+        self.recorder = None          # run telemetry (sgcn_tpu.obs)
+        self._gstep = 0               # completed batch steps (events are
+        #                               1-based, like FullBatchTrainer's)
+        self._comm_cum = None         # running cross-batch comm cumulative
+
+    def attach_recorder(self, recorder) -> None:
+        """Attach a ``sgcn_tpu.obs.RunRecorder``: every ``step(batch)``
+        appends one JSONL event (loss, wall time, merged comm split across
+        the per-batch counters).  The fused epoch sweep stays available but
+        emits no per-step events — use the stepwise ``fit`` under
+        telemetry."""
+        self.recorder = recorder
+
+    def _comm_snapshot(self, stats: CommStats) -> dict:
+        """O(k) running equivalent of ``CommStats.merged_report`` over every
+        batch counter that has passed through ``step()``: one step advances
+        exactly one batch's counters by a fixed per-step delta, so the
+        cross-batch cumulative is maintained incrementally instead of
+        re-merging all B counters each step (O(B²) per epoch).  Covers
+        RECORDED steps only — attach the recorder before training (the CLI
+        does) or the snapshot starts from the attach point."""
+        d = 2 * self.nlayers
+        per = (stats.send_volume_per_exchange, stats.send_msgs_per_exchange,
+               stats.recv_volume_per_exchange, stats.recv_msgs_per_exchange)
+        if self._comm_cum is None:
+            self._comm_cum = {
+                "arrs": [np.zeros_like(p, dtype=np.int64) for p in per],
+                "exchanges": 0, "send_volume": 0,
+            }
+        c = self._comm_cum
+        for acc, p in zip(c["arrs"], per):
+            acc += p.astype(np.int64) * d
+        c["exchanges"] += d
+        c["send_volume"] += int(per[0].sum()) * d
+        rep = CommStats.report_from_cumulative(*c["arrs"])
+        rep.update(                 # mini-batch steps are never pipelined
+            exchanges=c["exchanges"],
+            exposed_exchanges=c["exchanges"], hidden_exchanges=0,
+            exposed_send_volume=c["send_volume"], hidden_send_volume=0,
+        )
+        return rep
 
     # ------------------------------------------------------------------- data
     def make_batches(self, features: np.ndarray, labels: np.ndarray,
@@ -156,6 +197,7 @@ class MiniBatchTrainer:
 
     # ------------------------------------------------------------------- api
     def step(self, batch: Batch) -> float:
+        t0 = time.perf_counter()
         tr = self.inner
         tr.params, tr.opt_state, loss, tr.last_err = tr._step(
             tr.params, tr.opt_state, batch.pa, batch.data.h0,
@@ -165,29 +207,39 @@ class MiniBatchTrainer:
         # batches (GPU/PGCN-Mini-batch.py), so end-of-run stats carry the
         # same 8-number vocabulary
         batch.stats.count_step(nlayers=self.nlayers)
+        self._gstep += 1
+        if self.recorder is not None:
+            loss = float(loss)          # the event readback syncs the step
+            self.recorder.record_step(
+                step=self._gstep, loss=loss,
+                wall_s=time.perf_counter() - t0,
+                comm=self._comm_snapshot(batch.stats))
         return float(loss)
 
     def fit(self, features: np.ndarray, labels: np.ndarray,
             train_mask: np.ndarray | None = None, epochs: int = 1,
             warmup: int = 1, verbose: bool = True) -> dict:
         """Epoch = one pass over all pre-sampled batches (reference epoch
-        structure, ``GPU/PGCN-Mini-batch.py:231-306``)."""
+        structure, ``GPU/PGCN-Mini-batch.py:231-306``).  Timing routes
+        through the inner trainer's ``PhaseTimer`` (one phase-accounting
+        code path for both trainers)."""
+        timer = self.inner.timer
         batches = self.make_batches(features, labels, train_mask)
-        for _ in range(warmup):
-            self.step(batches[0])
-        jax.block_until_ready(self.inner.params)
+        with timer.phase("warmup", sync=lambda: self.inner.params):
+            for _ in range(warmup):
+                self.step(batches[0])
         history = []
-        t0 = time.perf_counter()
+        t_prior = timer.totals["train_step"]
         for ep in range(epochs):
             ep_loss = 0.0
-            for b in batches:
-                ep_loss += self.step(b)
+            with timer.phase("train_step", sync=lambda: self.inner.params):
+                for b in batches:
+                    ep_loss += self.step(b)
             ep_loss /= len(batches)
             history.append(ep_loss)
             if verbose:
                 print(f"epoch {ep}: batch-avg loss {ep_loss:.6f}", flush=True)
-        jax.block_until_ready(self.inner.params)
-        elapsed = time.perf_counter() - t0
+        elapsed = timer.totals["train_step"] - t_prior
         report = CommStats.merged_report([b.stats for b in batches])
         report.update(
             epochs=epochs,
@@ -195,10 +247,14 @@ class MiniBatchTrainer:
             elapsed_s=elapsed,
             epoch_s=elapsed / max(epochs, 1),
             loss_history=history,
+            phases=timer.report(),
             # legacy alias of total_send_volume (rows shipped across all
             # exchanges) — derived, not independently counted
             total_exchanged_rows=report["total_send_volume"],
         )
+        if self.recorder is not None:
+            self.recorder.record_summary(
+                {k: v for k, v in report.items() if k != "loss_history"})
         return report
 
     # ------------------------------------------------------- fused epoch path

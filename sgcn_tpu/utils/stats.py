@@ -119,7 +119,27 @@ class CommStats:
         """Aggregate many counters (e.g. one per mini-batch plan) the way one
         rank accumulates across batches in the reference: per-rank sums first,
         SUM/MAX over ranks second (``GPU/PGCN-Mini-batch.py`` shares the
-        counter dict across batches; ``Parallel-GCN/main.c:506-524``)."""
+        counter dict across batches; ``Parallel-GCN/main.c:506-524``).
+
+        Carries the hidden/exposed split through the merge (each counter's
+        per-exchange volume is its OWN plan's, so the split volumes sum per
+        counter, never from the merged totals) — the merged report satisfies
+        the same ``hidden + exposed == total`` reconciliation contract as a
+        single ``report()`` (``sgcn_tpu.obs.schema.COMM_SPLIT_KEYS``)."""
         parts = [s.cumulative() for s in stats_list]
         sums = [np.sum([p[i] for p in parts], axis=0) for i in range(4)]
-        return CommStats.report_from_cumulative(*sums)
+        rep = CommStats.report_from_cumulative(*sums)
+        exchanges = sum(s.exchanges for s in stats_list)
+        hidden = sum(s.hidden_exchanges for s in stats_list)
+        rep.update(
+            exchanges=exchanges,
+            exposed_exchanges=exchanges - hidden,
+            hidden_exchanges=hidden,
+            exposed_send_volume=sum(
+                int(s.send_volume_per_exchange.sum())
+                * (s.exchanges - s.hidden_exchanges) for s in stats_list),
+            hidden_send_volume=sum(
+                int(s.send_volume_per_exchange.sum()) * s.hidden_exchanges
+                for s in stats_list),
+        )
+        return rep

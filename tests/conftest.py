@@ -34,6 +34,33 @@ def pytest_configure(config):
         "run the full suite with plain `pytest tests/`")
 
 
+# Per-test wall-clock budget for NON-slow tests: the tier-1 suite runs under
+# one external timeout, and the seed's failure mode was a single unmarked
+# test silently eating it (rc=124 with zero diagnostics).  A passing test
+# that overruns this budget is turned into a FAILURE naming the fix (mark it
+# slow), so the suite can never silently regress back.  0 disables.  The
+# static half of the same lint (subprocess-mesh tests must be slow-marked or
+# explicitly budgeted) lives in tests/test_collection_lint.py.
+TIER1_PER_TEST_BUDGET_S = float(os.environ.get("SGCN_TEST_BUDGET_S", "300"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if (report.when == "call" and report.passed
+            and TIER1_PER_TEST_BUDGET_S > 0
+            and call.duration > TIER1_PER_TEST_BUDGET_S
+            and "slow" not in item.keywords):
+        report.outcome = "failed"
+        report.longrepr = (
+            f"{item.nodeid} took {call.duration:.1f}s, over the "
+            f"{TIER1_PER_TEST_BUDGET_S:.0f}s tier-1 per-test budget for "
+            "unmarked tests — mark it @pytest.mark.slow (or raise "
+            "SGCN_TEST_BUDGET_S if the budget itself is wrong); see "
+            "tests/test_collection_lint.py")
+
+
 def er_graph(n: int = 48, p: float = 0.15, seed: int = 1) -> sp.csr_matrix:
     """Symmetric Erdős–Rényi graph, no self-loops, float32."""
     rng = np.random.default_rng(seed)

@@ -1,0 +1,86 @@
+"""Tier-1 gate: every checked-in bench evidence file passes the validator.
+
+``scripts/validate_bench.py`` encodes the evidence contracts (driver-record
+shape, graceful-degradation markers, measurement-quality consistency, the
+pow2-k RB constraint from the PR-2 review incident); running it in the
+tier-1 flow means a hand-edited or unreproducible artifact fails CI the
+commit it lands, not a review round later.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from validate_bench import (check_bench_record, check_multichip_record,  # noqa: E402
+                            check_products_ksweep, validate_tree)
+
+
+def test_checked_in_artifacts_validate():
+    problems = validate_tree(REPO)
+    assert not problems, "\n".join(problems)
+
+
+def test_validator_catches_null_value_without_marker():
+    rec = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+           "parsed": {"metric": "m", "value": None, "unit": "s"}}
+    assert any("skipped/degraded" in e for e in check_bench_record(rec))
+    rec["parsed"]["degraded"] = "flagship phase exceeded its deadline"
+    assert not check_bench_record(rec)
+
+
+def test_validator_catches_impossible_measurement_block():
+    rec = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+           "parsed": {"metric": "m", "value": 1.0, "unit": "s",
+                      "measurement": {"clean_estimates": 5,
+                                      "target_estimates": 3}}}
+    assert any("measurement" in e for e in check_bench_record(rec))
+
+
+def test_validator_catches_silent_multichip_failure():
+    assert any("skipped/degraded" in e for e in check_multichip_record(
+        {"n_devices": 8, "ok": False, "rc": 0}))
+    # non-zero rc is its own explanation (historical round-1/5 records)
+    assert not check_multichip_record({"n_devices": 8, "ok": False,
+                                       "rc": 124})
+
+
+def test_validator_enforces_pow2_rb_constraint():
+    """The PR-2 incident shape: hp_rb data at non-pow2 k is unreproducible
+    with the code at HEAD and must fail validation."""
+    bad = {"sweep": {"ba": {"9": {"hp": {"km1": 5, "time_s": 1.0},
+                                  "hp_rb": {"km1": 4, "time_s": 1.0}}}}}
+    errs = check_products_ksweep(bad)
+    assert any("hp_rb" in e and "unreproducible" in e for e in errs)
+    ok = {"sweep": {"ba": {"32": {"hp": {"km1": 5, "time_s": 1.0},
+                                  "hp_rb": {"km1": 4, "time_s": 1.0}},
+                           "8": {"hp": {"km1": 7, "time_s": 1.0}}}}}
+    assert not check_products_ksweep(ok)
+
+
+def test_validator_rejects_nonstandard_json(tmp_path):
+    d = tmp_path
+    (d / "bench_artifacts").mkdir()
+    (d / "BENCH_r01.json").write_text(
+        '{"n": 1, "cmd": "x", "rc": 0, "tail": "", '
+        '"parsed": {"metric": "m", "value": NaN}}')
+    problems = validate_tree(str(d))
+    assert any("unparseable" in p and "NaN" in p for p in problems)
+
+
+def test_validator_cli_exit_codes(tmp_path):
+    import subprocess
+
+    script = os.path.join(REPO, "scripts", "validate_bench.py")
+    r = subprocess.run([sys.executable, script, str(REPO)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    bad = tmp_path
+    (bad / "MULTICHIP_r99.json").write_text(
+        json.dumps({"n_devices": 8, "ok": False, "rc": 0}))
+    r = subprocess.run([sys.executable, script, str(bad)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "violation" in r.stdout
