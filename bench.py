@@ -110,6 +110,45 @@ def diff_time_q(make_run, lo: int, hi: int, reps: int = 5,
         f"t({lo} ep)={t_lo:.4f}s in every attempt (chip contention?)")
 
 
+def paired_differential(make_a, make_b, nep: int, reps: int = 6,
+                        what: str = "A/B"):
+    """Rep-level PAIRED differential timing of two arms — THE shared A/B
+    protocol of the one-process children (stale, ragged-schedule).
+
+    This 2-core host drifts by tens of percent over minutes (measured
+    exact-arm pre/post spreads up to 1.6×), so two separately-timed phases
+    — or two separate child processes — turn a <10% effect into a coin
+    flip.  Each rep times the four runs (arm-A lo/hi, arm-B lo/hi) back to
+    back within seconds, forms BOTH differentials from the same machine
+    state, and the medians over clean reps are compared.  ``make_*`` are
+    ``make_run``-style factories (nep → zero-arg runner returning a synced
+    finite scalar); returns ``(a_s, b_s, clean_pairs)`` per-epoch times.
+    """
+    runs = [make_a(1), make_a(nep), make_b(1), make_b(nep)]
+    for r in runs:
+        r()                                   # compile + warm, retired
+    a_lo, a_hi, b_lo, b_hi = runs
+
+    def timed(run):
+        t0 = time.perf_counter()
+        v = run()
+        dt = time.perf_counter() - t0
+        if not np.isfinite(v):
+            raise RuntimeError(f"non-finite loss {v}")
+        return dt
+
+    d_a, d_b = [], []
+    for _ in range(reps):
+        ta_lo, tb_lo = timed(a_lo), timed(b_lo)
+        ta_hi, tb_hi = timed(a_hi), timed(b_hi)
+        if ta_hi > ta_lo and tb_hi > tb_lo:
+            d_a.append((ta_hi - ta_lo) / (nep - 1))
+            d_b.append((tb_hi - tb_lo) / (nep - 1))
+    if not d_a:
+        raise RuntimeError(f"{what}: no clean paired differentials")
+    return statistics.median(d_a), statistics.median(d_b), len(d_a)
+
+
 class _PhaseDeadlineExpired(RuntimeError):
     """A bench phase exceeded its own deadline (degraded, not a bug)."""
 
@@ -159,7 +198,8 @@ def _backend_unavailable(e: Exception) -> bool:
 def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
               dtype: str | None = None, remat: bool = False,
               halo_staleness: int = 0, halo_delta: bool = False,
-              sync_every: int = 0, step_dispatch: bool = False):
+              sync_every: int = 0, step_dispatch: bool = False,
+              comm_schedule: str | None = None):
     import jax
 
     # The axon sitecustomize pre-registers the TPU plugin at interpreter
@@ -197,9 +237,19 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
                   sync_every=sync_every)
         part_metrics.update(halo_staleness=halo_staleness,
                             halo_delta=halo_delta, sync_every=sync_every)
+    if comm_schedule is not None and model == "gcn":
+        kw["comm_schedule"] = comm_schedule
     trainer = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths,
                                mesh=mesh, compute_dtype=dtype, remat=remat,
                                **kw)
+    if model == "gcn":
+        # padded-vs-true accounting of the SELECTED transport (the resolved
+        # schedule when 'auto' was asked; docs/comm_schedule.md)
+        part_metrics["comm_schedule"] = trainer.comm_schedule
+        part_metrics["padding_efficiency"] = round(
+            trainer.stats.padding_efficiency, 6)
+        part_metrics["wire_rows_per_exchange"] = \
+            trainer.stats.wire_rows_per_exchange
     data = make_train_data(plan, feats, labels)
     data = type(data)(**shard_stacked(mesh, vars(data)))
     # DIFFERENTIAL timing (round-3 protocol, see diff_time): the reference's
@@ -261,7 +311,8 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
 
 
 def bench_minibatch(ahat, feats, labels, widths, batch_size: int,
-                    epochs: int, dtype: str | None = None):
+                    epochs: int, dtype: str | None = None,
+                    comm_schedule: str | None = None):
     """Mini-batch trainer epoch (PGCN-Mini-batch role, Reddit-config shape):
     one pass over all pre-sampled batches, run as ONE on-device program
     (``run_epochs_fused``) and timed differentially like the flagship."""
@@ -279,7 +330,8 @@ def bench_minibatch(ahat, feats, labels, widths, batch_size: int,
     else:
         pv = np.zeros(n, dtype=np.int64)
     tr = MiniBatchTrainer(ahat, pv, k, fin=feats.shape[1], widths=widths,
-                          batch_size=batch_size, compute_dtype=dtype)
+                          batch_size=batch_size, compute_dtype=dtype,
+                          comm_schedule=comm_schedule)
 
     def make_run(nep):
         def run():
@@ -292,6 +344,18 @@ def bench_minibatch(ahat, feats, labels, widths, batch_size: int,
     return epoch_s, {
         "nbatches": len(tr.plans),
         "batch_size": batch_size,
+        # the RESOLVED transport — never measure one schedule while the
+        # JSON claims another (same honesty rule as the flagship block).
+        # Per-EXCHANGE wire rows are uniform across batches (all plans
+        # share one padded envelope), so plans[0] speaks for every exchange
+        # — same key, same semantics as the flagship/CommStats figure
+        "comm_schedule": tr.inner.comm_schedule,
+        "wire_rows_per_exchange":
+            tr.plans[0].wire_rows_per_exchange(tr.inner.comm_schedule),
+        "padding_efficiency": round(
+            sum(int(p.predicted_send_volume.sum()) for p in tr.plans)
+            / max(sum(p.wire_rows_per_exchange(tr.inner.comm_schedule)
+                      for p in tr.plans), 1), 6),
         # deterministic per-epoch figure (the trainer-level CommStats
         # counters accumulate over warm-ups/retries and are not a metric)
         "comm_volume_rows_per_epoch":
@@ -532,39 +596,8 @@ def bench_stale_ab_child(ahat, feats, labels, widths, epochs: int,
             return run
         return make_run
 
-    # Pair the arms at the REP level: this 2-core host drifts by tens of
-    # percent over minutes (measured exact-arm pre/post spreads up to 1.6×),
-    # so two separately-timed phases — or two separate child processes —
-    # turn a <10% effect into a coin flip.  Each rep times the four runs
-    # (exact lo/hi, stale lo/hi) back to back within seconds, forms BOTH
-    # differentials from the same machine state, and the medians over reps
-    # are compared.
-    exact_mk, stale_mk = arm(), arm(halo_staleness=1)
-    nep = max(8, epochs)
-    runs = [exact_mk(1), exact_mk(nep), stale_mk(1), stale_mk(nep)]
-    for r in runs:
-        r()                                   # compile + warm, retired
-    e_lo, e_hi, s_lo, s_hi = runs
-
-    def timed(run):
-        t0 = time.perf_counter()
-        v = run()
-        dt = time.perf_counter() - t0
-        if not np.isfinite(v):
-            raise RuntimeError(f"non-finite loss {v}")
-        return dt
-
-    d_exact, d_stale = [], []
-    for _ in range(6):
-        te_lo, ts_lo = timed(e_lo), timed(s_lo)
-        te_hi, ts_hi = timed(e_hi), timed(s_hi)
-        if te_hi > te_lo and ts_hi > ts_lo:
-            d_exact.append((te_hi - te_lo) / (nep - 1))
-            d_stale.append((ts_hi - ts_lo) / (nep - 1))
-    if not d_exact:
-        raise RuntimeError("stale A/B: no clean paired differentials")
-    exact_s = statistics.median(d_exact)
-    stale_s = statistics.median(d_stale)
+    exact_s, stale_s, clean = paired_differential(
+        arm(), arm(halo_staleness=1), max(8, epochs), what="stale A/B")
     return {
         "epoch_s_exact": round(exact_s, 6),
         "epoch_s_stale1": round(stale_s, 6),
@@ -572,11 +605,118 @@ def bench_stale_ab_child(ahat, feats, labels, widths, epochs: int,
         # minus the per-layer exchange dependence
         "exposed_comm_s_estimate": round(exact_s - stale_s, 6),
         "stale_speedup": round(exact_s / stale_s, 3),
-        "clean_pairs": len(d_exact),
+        "clean_pairs": clean,
         "n": n, "graph": graph, "km1": int(km1),
         "timing": "per-step dispatch, one process, rep-level paired "
-                  "differentials (see bench_stale_ab_child)",
+                  "differentials (see paired_differential)",
     }
+
+
+def bench_ragged_ab(n: int, avg_deg: int, f: int, widths, epochs: int,
+                    graph: str = "ba"):
+    """A/B the dense a2a vs the ragged ppermute-ring schedule on the
+    8-virtual-device CPU mesh, across one BALANCED (random) and one SKEWED
+    (native hp) partition of the same power-law graph — the configs where
+    the padded/true ratio differs most (docs/comm_schedule.md).  One child
+    process runs all four arms over shared process state (the
+    between-process variance lesson of ``bench_stale_ab``).  Degrades to a
+    marked partial block on child failure."""
+    block: dict = {"ragged_ab_8dev": None}
+    try:
+        child = _run_vdev_child(n, avg_deg, f, widths, epochs, graph,
+                                extra_args=("--ragged-ab-child",))
+        child.pop("metric", None)
+        child.pop("value", None)
+        block["ragged_ab_8dev"] = child
+        return block
+    except subprocess.TimeoutExpired:
+        print("# ragged A/B run exceeded its deadline", file=sys.stderr)
+        block["ragged_ab_degraded"] = "deadline"
+        return block
+    except Exception as e:                      # noqa: BLE001 — diagnostic path
+        print(f"# ragged A/B run failed: {e!r}", file=sys.stderr)
+        block["ragged_ab_degraded"] = repr(e)[:200]
+        return block
+
+
+def bench_ragged_ab_child(ahat, feats, labels, widths, epochs: int,
+                          graph: str) -> dict:
+    """One-process a2a-vs-ragged A/B (the ``--ragged-ab-child`` body).
+
+    Per partition (balanced random, skewed hp): one plan, one mesh, both
+    schedule trainers; rep-level PAIRED differentials exactly like
+    ``bench_stale_ab_child`` (this 2-core host drifts too much for
+    separately timed phases); per-step dispatch so neither arm hides
+    behind the fused sweep.  Each config emits the padded/true wire-row
+    ratio next to its timings — the quantity the ragged schedule exists to
+    shrink."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from sgcn_tpu.parallel import build_comm_plan, make_mesh_1d
+    from sgcn_tpu.parallel.mesh import shard_stacked
+    from sgcn_tpu.partition import (balanced_random_partition,
+                                    partition_hypergraph_colnet)
+    from sgcn_tpu.train import FullBatchTrainer, make_train_data
+
+    k = len(jax.devices())
+    n = ahat.shape[0]
+    out: dict = {"n": n, "graph": graph, "k": k,
+                 "timing": "per-step dispatch, one process, rep-level "
+                           "paired differentials (see paired_differential)"}
+    parts: list[tuple[str, np.ndarray, int | None]] = [
+        ("random", balanced_random_partition(n, k, seed=1), None)]
+    if k > 1:
+        pv_hp, km1 = partition_hypergraph_colnet(ahat, k, seed=0)
+        parts.append(("hp", pv_hp, int(km1)))
+    mesh = make_mesh_1d(k)
+    nep = max(6, epochs)
+    for name, pv, km1 in parts:
+        plan = build_comm_plan(ahat, pv, k)
+        plan.ensure_ragged()
+        data = make_train_data(plan, feats, labels)
+        data = type(data)(**shard_stacked(mesh, vars(data)))
+
+        def arm(schedule):
+            tr = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths,
+                                  mesh=mesh, comm_schedule=schedule)
+
+            def make_run(n_ep):
+                def run():
+                    loss = None
+                    for _ in range(n_ep):
+                        loss = tr.step(data, sync=False)
+                    return float(loss)    # in-order dispatch syncs the run
+                return run
+            return make_run
+
+        a2a_s, rag_s, clean = paired_differential(
+            arm("a2a"), arm("ragged"), nep, what=f"ragged A/B ({name})")
+        true = int(plan.predicted_send_volume.sum())
+        wire_a2a = plan.wire_rows_per_exchange("a2a")
+        wire_rag = plan.wire_rows_per_exchange("ragged")
+        cfg = {
+            "epoch_s_a2a": round(a2a_s, 6),
+            "epoch_s_ragged": round(rag_s, 6),
+            "ragged_speedup": round(a2a_s / rag_s, 3),
+            "clean_pairs": clean,
+            "padding_efficiency": round(plan.padding_efficiency(), 6),
+            # the padded/true wire-row ratio of each schedule — the dense
+            # a2a's is the overhead the ragged ring deletes
+            "padded_true_ratio_a2a": (round(wire_a2a / true, 3)
+                                      if true else None),
+            "padded_true_ratio_ragged": (round(wire_rag / true, 3)
+                                         if true else None),
+            "wire_rows_a2a": wire_a2a,
+            "wire_rows_ragged": wire_rag,
+            "true_rows": true,
+            "rounds": len(plan.rr_sizes),
+        }
+        if km1 is not None:
+            cfg["km1"] = km1
+        out[name] = cfg
+    return out
 
 
 def bench_ab_baseline(args, rev: str) -> dict:
@@ -784,6 +924,19 @@ def main() -> None:
     p.add_argument("--stale-ab-n", type=int, default=40_000,
                    help="graph size for the stale A/B children (two extra "
                         "CPU-mesh runs; smaller than --vdev-n by default)")
+    p.add_argument("--comm-schedule", default=None,
+                   choices=["a2a", "ragged", "auto"],
+                   help="halo transport for the flagship run "
+                        "(docs/comm_schedule.md): dense all_to_all, "
+                        "per-round-sized ppermute ring, or plan-driven "
+                        "auto-select; default $SGCN_COMM_SCHEDULE else a2a")
+    p.add_argument("--skip-ragged-ab", action="store_true",
+                   help="skip the a2a-vs-ragged schedule A/B on the "
+                        "virtual 8-device mesh")
+    p.add_argument("--ragged-ab-n", type=int, default=30_000,
+                   help="graph size for the ragged A/B child (one extra "
+                        "CPU-mesh run covering a balanced-random and a "
+                        "skewed hp partition)")
     p.add_argument("--step-dispatch", action="store_true",
                    help="time one step() dispatch per epoch instead of the "
                         "fused on-device epoch loop (the stale A/B timing "
@@ -819,8 +972,17 @@ def main() -> None:
     p.add_argument("--vdev-child", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--stale-ab-child", action="store_true",
                    help=argparse.SUPPRESS)
+    p.add_argument("--ragged-ab-child", action="store_true",
+                   help=argparse.SUPPRESS)
     args = p.parse_args()
 
+    if args.comm_schedule == "ragged" and (args.model != "gcn"
+                                           or args.halo_staleness):
+        # never measure one transport while the JSON claims another
+        raise SystemExit(
+            "--comm-schedule ragged drives the GCN exact exchange only "
+            "(GAT ships attention tables over the dense a2a; composition "
+            "with --halo-staleness 1 is deferred)")
     if (args.halo_delta or args.sync_every) and not args.halo_staleness:
         # match the trainer CLI: silently measuring exact mode while the
         # JSON reader believes it was the delta wire would be a lie
@@ -845,6 +1007,15 @@ def main() -> None:
         }))
         return
 
+    if args.ragged_ab_child:
+        print(json.dumps({
+            "metric": "ragged_ab",
+            "value": None,      # the per-partition blocks are the payload
+            **bench_ragged_ab_child(ahat, feats, labels, widths, args.epochs,
+                                    graph=args.graph),
+        }))
+        return
+
     if args.batch_size is not None:
         if args.model != "gcn":
             raise SystemExit(
@@ -855,7 +1026,8 @@ def main() -> None:
                              "trainer; drop it or bench full-batch")
         mb_s, mb_metrics = bench_minibatch(ahat, feats, labels, widths,
                                            args.batch_size, args.epochs,
-                                           dtype=args.dtype)
+                                           dtype=args.dtype,
+                                           comm_schedule=args.comm_schedule)
         if args.dtype:
             mb_metrics["compute_dtype"] = args.dtype
         _emit_result({
@@ -887,7 +1059,8 @@ def main() -> None:
                 model=args.model, dtype=args.dtype, remat=args.remat,
                 halo_staleness=args.halo_staleness,
                 halo_delta=args.halo_delta, sync_every=args.sync_every,
-                step_dispatch=args.step_dispatch)
+                step_dispatch=args.step_dispatch,
+                comm_schedule=args.comm_schedule)
     except _PhaseDeadlineExpired as e:
         _emit_result({**partial, "degraded": str(e)}, args)
         return
@@ -935,6 +1108,11 @@ def main() -> None:
                 and not args.skip_stale_ab):
             vdev_metrics.update(bench_stale_ab(
                 args.stale_ab_n, args.avg_deg, args.f, widths,
+                max(2, args.epochs // 2), graph=args.vdev_graph))
+        if (args.model == "gcn" and args.halo_staleness == 0
+                and not args.skip_ragged_ab):
+            vdev_metrics.update(bench_ragged_ab(
+                args.ragged_ab_n, args.avg_deg, args.f, widths,
                 max(2, args.epochs // 2), graph=args.vdev_graph))
     extra = {}
     if not args.vdev_child:

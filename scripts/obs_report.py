@@ -112,6 +112,15 @@ def render(path: str, max_steps: int = 12) -> str:
                 f"{comm['total_send_volume']} = "
                 f"{comm['exposed_send_volume']} + "
                 f"{comm['hidden_send_volume']}")
+            if "wire_rows_per_exchange" in comm:
+                # padded-vs-true split of the selected exchange schedule
+                # (docs/comm_schedule.md)
+                lines.append(
+                    f"  wire ({comm.get('comm_schedule', 'a2a')} schedule): "
+                    f"{comm['wire_rows_per_exchange']} padded rows/exchange "
+                    f"for {comm.get('true_rows_per_exchange', '?')} true — "
+                    f"padding efficiency "
+                    f"{_fmt(comm.get('padding_efficiency'), 3)}")
         drifts = [s["drift"] for s in steps if s.get("drift")]
         if drifts:
             lines.append("\ndrift gauges (stale-halo mode):")

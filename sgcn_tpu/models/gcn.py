@@ -21,18 +21,24 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.pspmm import pspmm_ell_sym, pspmm_overlap, pspmm_stale
+from ..ops.pspmm import (pspmm_ell_sym, pspmm_overlap, pspmm_ragged_sym,
+                         pspmm_stale)
 from ..parallel.mesh import AXIS
 from .activations import get_activation
 
 # plan arrays the GCN forward consumes (fullbatch ships exactly these).
 # Symmetric Â takes the ELL + symmetric-backward fast path; general Â the
 # split-COO overlap path whose backward is JAX's mechanical transpose.
+# Under comm_schedule='ragged' the symmetric path swaps the dense a2a
+# arrays for the per-round ppermute-ring layout (CommPlan.ensure_ragged).
 GCN_PLAN_FIELDS_SYM = ("send_idx", "halo_src", "ell_idx", "ell_w",
                        "ltail_dst", "ltail_src", "ltail_w",
                        "hedge_dst", "hedge_src", "hedge_w")
 GCN_PLAN_FIELDS_GEN = ("send_idx", "halo_src", "ledge_dst", "ledge_src",
                        "ledge_w", "hedge_dst", "hedge_src", "hedge_w")
+GCN_PLAN_FIELDS_RAGGED = ("rsend_idx", "ell_idx", "ell_w",
+                          "ltail_dst", "ltail_src", "ltail_w",
+                          "redge_dst", "redge_src", "redge_w")
 
 
 def gcn_plan_fields(plan):
@@ -85,6 +91,11 @@ def gcn_forward_local(
                                         # ('bfloat16' halves ICI bytes;
                                         # tables/activations stay f32 —
                                         # ops/pspmm.py::halo_exchange)
+    comm_schedule: str = "a2a",         # static: 'a2a' (dense all_to_all)
+                                        # or 'ragged' (per-round ppermute
+                                        # ring, docs/comm_schedule.md)
+    rr_sizes: tuple | None = None,      # static plan.rr_sizes (ragged)
+    rr_edge_sizes: tuple | None = None,  # static plan.rr_edge_sizes (ragged)
     axis_name: str = AXIS,
 ):
     """Per-chip forward: L × (pspmm ⊗ dense matmul → activation) → (B, nout).
@@ -108,7 +119,30 @@ def gcn_forward_local(
     fact = get_activation(final_activation)
     nl = len(params)
 
-    if symmetric and pallas_tb is not None:
+    if comm_schedule not in ("a2a", "ragged"):
+        raise ValueError(f"unknown comm_schedule {comm_schedule!r} "
+                         "(the trainer resolves 'auto' before the forward)")
+    if comm_schedule == "ragged":
+        # ragged ppermute ring (docs/comm_schedule.md): per-round-sized
+        # buffers replace the globally-padded a2a; same math, f32
+        # bit-identical by construction (plan-time round-order edge sort)
+        if not symmetric:
+            raise ValueError(
+                "comm_schedule='ragged' uses the symmetric custom backward "
+                "(the gradient rides the same ring); asymmetric plans run "
+                "the a2a schedule")
+        if ell_buckets is None or rr_sizes is None or rr_edge_sizes is None:
+            raise ValueError(
+                "ragged GCN forward needs the plan's static ell_buckets + "
+                "rr_sizes + rr_edge_sizes (CommPlan.ensure_ragged)")
+
+        def agg(x):
+            return pspmm_ragged_sym(
+                x, pa["rsend_idx"], pa["ell_idx"], pa["ell_w"],
+                pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
+                pa["redge_dst"], pa["redge_src"], pa["redge_w"],
+                ell_buckets, rr_sizes, rr_edge_sizes, axis_name, halo_dtype)
+    elif symmetric and pallas_tb is not None:
         # plan-driven kernel choice: per-chip tables fit the VMEM-resident
         # Pallas kernel (ops/pallas_spmm.py::use_pallas_spmm) — the regime
         # k-way sharding produces as k grows

@@ -17,9 +17,15 @@ Three quantities per training step:
     at the layer's true aggregation width, forward + backward (backward ≈
     2× the dense forward — dX and dW — plus one more SpMM pass under the
     symmetric custom VJP).
-  * **halo bytes** — wire bytes per exchange from the plan's predicted send
-    volume (== Σ(λ−1), the partitioner connectivity metric) at the wire
-    dtype, and per step from the exchange count (2·L: forward + backward).
+  * **halo bytes** — TWO figures per exchange (the padded-vs-true split of
+    docs/comm_schedule.md): ``halo_bytes_true`` from the plan's predicted
+    send volume (== Σ(λ−1), the connectivity metric the partitioner
+    optimizes) and ``halo_bytes_wire`` from what the SELECTED schedule
+    actually ships — ``k²·S·f·itemsize`` for the dense a2a,
+    ``Σ_d k·S_d·f·itemsize`` for the ragged ppermute ring — at the wire
+    dtype, per step from the exchange count (2·L: forward + backward).
+    The exposed-comm attribution charges wire bytes (what crosses ICI),
+    never the under-count the true volume would give on a padded schedule.
 
 Nothing here imports jax at module scope — the CLIs configure the backend
 before heavy imports, and this module must be importable first.
@@ -71,20 +77,32 @@ class StepCostModel:
     dense_flops: int        # fwd dense-projection FLOPs per chip
     step_flops: int         # fwd+bwd total per chip (2·spmm + 3·dense)
     gather_bytes: int       # fwd+bwd gather-stream bytes per chip
-    halo_send_rows: int     # global boundary rows per exchange (Σ(λ−1))
-    halo_bytes_per_exchange: int   # global wire bytes per exchange
-    halo_bytes_per_step: int       # 2·L exchanges per training step
+    halo_send_rows: int     # global TRUE boundary rows per exchange (Σ(λ−1))
+    halo_bytes_per_exchange: int   # global TRUE bytes per exchange (legacy
+    #                                name; == the Σ(λ−1) volume)
+    halo_bytes_per_step: int       # 2·L exchanges per training step (true)
     per_layer: list = field(default_factory=list)  # [{width, spmm_flops,
-    #   dense_flops, halo_bytes}] — the attribution table obs_report renders
+    #   dense_flops, halo_bytes, halo_bytes_true, halo_bytes_wire}] — the
+    #   attribution table obs_report renders
+    # padded-vs-true split of the selected exchange schedule
+    comm_schedule: str = "a2a"
+    halo_wire_rows: int = 0        # padded rows per exchange on the wire
+    padding_efficiency: float = 1.0  # halo_send_rows / halo_wire_rows
+    halo_bytes_true_per_step: int = 0   # == halo_bytes_per_step (explicit)
+    halo_bytes_wire_per_step: int = 0   # what the schedule ships per step
 
 
 def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
-              wire_itemsize: int | None = None) -> StepCostModel:
+              wire_itemsize: int | None = None,
+              comm_schedule: str = "a2a") -> StepCostModel:
     """Build the cost model for one (plan, layer-stack) pair.
 
     ``compute_dtype='bfloat16'`` halves the gather/wire itemsize (the
     packed bf16 path); ``wire_itemsize`` overrides the wire bytes alone
-    (the ``--halo-dtype bfloat16`` wire-only lever)."""
+    (the ``--halo-dtype bfloat16`` wire-only lever).  ``comm_schedule``
+    selects the wire-byte model: the plan's TRUE volume (Σ(λ−1)) is
+    schedule-independent, but the shipped bytes are the schedule's padded
+    buffer — ``plan.wire_rows_per_exchange(schedule)``."""
     from ..models.gcn import exchange_widths
 
     itemsize = 2 if compute_dtype == "bfloat16" else 4
@@ -94,18 +112,24 @@ def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
     nnz = int(plan.nnz.max()) if plan.nnz.size else 0
     b = plan.b
     send_rows = int(plan.predicted_send_volume.sum())
+    wire_rows = int(plan.wire_rows_per_exchange(comm_schedule))
 
     per_layer, spmm_f, dense_f = [], 0, 0
     for (fi, fo), w in zip(dims, fs):
         lf_spmm = 2 * nnz * w           # one multiply-add per (edge, lane)
         lf_dense = 2 * b * fi * fo
         hb = send_rows * w * wire_b
+        hbw = wire_rows * w * wire_b
         per_layer.append({"width": int(w), "spmm_flops": int(lf_spmm),
-                          "dense_flops": int(lf_dense), "halo_bytes": int(hb)})
+                          "dense_flops": int(lf_dense), "halo_bytes": int(hb),
+                          "halo_bytes_true": int(hb),
+                          "halo_bytes_wire": int(hbw)})
         spmm_f += lf_spmm
         dense_f += lf_dense
     halo_per_ex = sum(pl["halo_bytes"] for pl in per_layer) // max(
         len(per_layer), 1)
+    true_step = int(2 * sum(pl["halo_bytes_true"] for pl in per_layer))
+    wire_step = int(2 * sum(pl["halo_bytes_wire"] for pl in per_layer))
     return StepCostModel(
         nlayers=len(widths),
         widths=[int(w) for w in fs],
@@ -117,9 +141,13 @@ def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
                                                 itemsize=itemsize)),
         halo_send_rows=send_rows,
         halo_bytes_per_exchange=int(halo_per_ex),
-        halo_bytes_per_step=int(2 * sum(pl["halo_bytes"]
-                                        for pl in per_layer)),
+        halo_bytes_per_step=true_step,
         per_layer=per_layer,
+        comm_schedule=comm_schedule,
+        halo_wire_rows=wire_rows,
+        padding_efficiency=(send_rows / wire_rows if wire_rows else 1.0),
+        halo_bytes_true_per_step=true_step,
+        halo_bytes_wire_per_step=wire_step,
     )
 
 
@@ -146,9 +174,22 @@ def roofline_fields(cost: StepCostModel, wall_s: float,
         "model_step_GFLOP": sig(cost.step_flops / 1e9, 6),
         "achieved_GFLOPs": sig(cost.step_flops / wall_s / 1e9),
         "halo_bytes_per_step": cost.halo_bytes_per_step,
+        # the padded-vs-true wire split (schema.ROOFLINE_WIRE_KEYS):
+        # *_true is the Σ(λ−1) volume the partitioner optimizes, *_wire the
+        # selected schedule's shipped bytes — these must reconcile EXACTLY
+        # with CommStats' wire_rows/padding_efficiency gauges
+        "comm_schedule": cost.comm_schedule,
+        "halo_bytes_true_per_step": cost.halo_bytes_true_per_step,
+        "halo_bytes_wire_per_step": cost.halo_bytes_wire_per_step,
+        "halo_wire_rows_per_exchange": cost.halo_wire_rows,
+        "padding_efficiency": cost.padding_efficiency,
     }
     if exchanges > 0:
         out["exposed_comm_frac"] = round(exposed_exchanges / exchanges, 6)
+        # exposed bytes charge the WIRE volume: a padded schedule's dead
+        # slots cross ICI and sit on the critical path like any other byte
+        # (the pre-ragged model charged Σ(λ−1) and under-counted exactly
+        # the padding a schedule should be judged on)
         out["exposed_halo_bytes"] = int(
-            cost.halo_bytes_per_step * exposed_exchanges / exchanges)
+            cost.halo_bytes_wire_per_step * exposed_exchanges / exchanges)
     return out

@@ -69,6 +69,19 @@ COMM_SPLIT_KEYS = ("exchanges", "exposed_exchanges", "hidden_exchanges",
                    "exposed_send_volume", "hidden_send_volume",
                    "total_send_volume")
 
+# roofline wire-byte fields (PR-4, backward-compatible v1 addition): when a
+# step event's roofline block carries ANY of these, it must carry them all —
+# the padded-vs-true split is meaningless in halves.  Old run directories
+# (rooflines without the split) still validate: absence is legal, an
+# incomplete split is not.  ``halo_bytes_true_per_step`` is the Σ(λ−1)
+# volume the partitioner optimizes; ``halo_bytes_wire_per_step`` what the
+# selected schedule ships (k²·S·f dense a2a, Σ_d k·S_d·f ragged);
+# ``padding_efficiency`` their row-level ratio in [0, 1].
+ROOFLINE_WIRE_KEYS = ("comm_schedule", "halo_bytes_true_per_step",
+                      "halo_bytes_wire_per_step",
+                      "halo_wire_rows_per_exchange", "padding_efficiency")
+COMM_SCHEDULES = ("a2a", "ragged", "mixed")
+
 # drift-gauge fields (stale mode only): the AUTHORITATIVE field list —
 # ``validate_event`` requires every one of these in a step event's ``drift``
 # block, so this tuple, the trainer's ``_drift_fields`` and the
@@ -128,6 +141,31 @@ def validate_event(ev: dict) -> None:
                 "step event comm snapshot violates the hidden/exposed "
                 f"split: {comm['exposed_exchanges']} + "
                 f"{comm['hidden_exchanges']} != {comm['exchanges']}")
+    if kind == "step" and isinstance(ev.get("roofline"), dict):
+        roof = ev["roofline"]
+        present = [k for k in ROOFLINE_WIRE_KEYS if k in roof]
+        if present and len(present) != len(ROOFLINE_WIRE_KEYS):
+            missing = [k for k in ROOFLINE_WIRE_KEYS if k not in roof]
+            raise ValueError(
+                f"step event roofline carries a partial wire split "
+                f"(has {present}, missing {missing}) — ship all of "
+                "ROOFLINE_WIRE_KEYS or none")
+        if present:
+            if roof["comm_schedule"] not in COMM_SCHEDULES:
+                raise ValueError(
+                    f"roofline comm_schedule {roof['comm_schedule']!r} not "
+                    f"one of {COMM_SCHEDULES}")
+            pe = roof["padding_efficiency"]
+            if not (isinstance(pe, _NUM) and 0 <= pe <= 1):
+                raise ValueError(
+                    f"roofline padding_efficiency {pe!r} outside [0, 1]")
+            if roof["halo_bytes_wire_per_step"] \
+                    < roof["halo_bytes_true_per_step"]:
+                raise ValueError(
+                    "roofline wire bytes below true bytes — a schedule "
+                    "cannot ship less than the unpadded volume "
+                    f"({roof['halo_bytes_wire_per_step']} < "
+                    f"{roof['halo_bytes_true_per_step']})")
     if kind == "step" and ev.get("drift") is not None:
         missing = [k for k in DRIFT_KEYS if k not in ev["drift"]]
         if missing:

@@ -139,6 +139,58 @@ def halo_exchange(h, send_idx, halo_src, axis_name: str = AXIS,
     return jnp.take(flat, halo_src, axis=0).astype(h.dtype)  # (R, f)
 
 
+def ppermute_or_identity(buf, axis_name: str, d: int):
+    """Round-``d`` ring shift of the ragged schedule: chip ``p`` sends
+    ``buf`` to chip ``(p+d) % k`` (so each chip receives from ``(p−d) % k``)
+    via ``lax.ppermute``.  Degrades to an ``optimization_barrier``-pinned
+    identity on a size-1 mesh axis under the SAME fidelity contract as
+    ``a2a_or_identity``: the shard-proxy measurement needs the send-side
+    gather to stay materialized exactly as on a real k-chip mesh."""
+    k = lax.axis_size(axis_name)
+    if k == 1:
+        (recv,) = lax.optimization_barrier((buf,))
+        return recv
+    return lax.ppermute(buf, axis_name,
+                        perm=[(p, (p + d) % k) for p in range(k)])
+
+
+def halo_exchange_ragged(h, rsend_idx, rhalo_dst, rr_sizes, r: int,
+                         axis_name: str = AXIS, halo_dtype=None):
+    """Ragged ppermute-ring halo exchange; returns the (R, f) halo block.
+
+    The plan-driven replacement for ``halo_exchange``'s dense all_to_all:
+    k−1 rounds of ``lax.ppermute`` where round ``d`` carries chip
+    p → (p+d)%k in a buffer statically sized to that round's max send count
+    (``rr_sizes[d-1]``, see ``CommPlan.ensure_ragged``) — per-round pad, not
+    global pad, so the wire carries Σ_d k·S_d rows instead of k²·S.  Rounds
+    with S_d = 0 vanish at trace time.  Received rows scatter into their
+    contiguous per-owner halo slice (``rhalo_dst``; padding slots target row
+    ``r`` and are dropped), so the table fills round by round as rows
+    arrive.  ``halo_dtype`` narrows the wire only, exactly like the dense
+    exchange's lever.
+
+    Args:
+      h: (B, f) local feature rows.
+      rsend_idx: (ΣS_d,) per-round send gather rows (round-major flat).
+      rhalo_dst: (ΣS_d,) halo rank of each receive slot (``r`` = padding).
+      rr_sizes: static per-round sizes, length k−1.
+      r: halo table height.
+    """
+    halo = jnp.zeros((r, h.shape[-1]), h.dtype)
+    off = 0
+    for d, sd in enumerate(rr_sizes, start=1):
+        if sd == 0:
+            continue
+        buf = jnp.take(h, rsend_idx[off: off + sd], axis=0)   # (S_d, f)
+        if halo_dtype is not None:
+            buf = buf.astype(halo_dtype)
+        recv = ppermute_or_identity(buf, axis_name, d)
+        halo = halo.at[rhalo_dst[off: off + sd]].set(
+            recv.astype(h.dtype), mode="drop")
+        off += sd
+    return halo
+
+
 def a2a_or_identity(buf, axis_name: str):
     """``lax.all_to_all`` of a per-peer-bucketed buffer, degrading to an
     identity on a size-1 mesh axis (jax's all_to_all rejects
@@ -322,6 +374,114 @@ def _pspmm_ell_sym_bwd(buckets, axis_name, halo_dtype, res, g):
 
 
 pspmm_ell_sym.defvjp(_pspmm_ell_sym_fwd, _pspmm_ell_sym_bwd)
+
+
+# -------------------------------------------------------------------- ragged
+# Ragged ppermute-ring PSpMM: the per-round exchange of halo_exchange_ragged
+# with FOLD-AS-YOU-ARRIVE remote aggregation — round d's halo-src edges
+# (split per owner at plan time, src re-based to the round's receive buffer)
+# scatter-add straight into the output accumulator, so each round's remote
+# contribution folds while later rounds are still in flight: the TPU
+# dependence-structure expression of the reference's post-Irecv
+# compute-local / accumulate-arrivals loop (Parallel-GCN/main.c:238-299).
+#
+# f32 bit-parity with the dense schedule is STRUCTURAL, not approximate: the
+# plan sorts the dense hedge family by (dst, round, recv-pos), and XLA's
+# scatter-add applies updates in order, so the round-major chain of scatters
+# below performs, per output slot, the exact addition sequence of the dense
+# path's single halo-src segment-sum (verified by tests/test_ragged.py).
+
+
+def _ragged_remote(x, rsend_idx, redge_dst, redge_src, redge_w,
+                   rr_sizes, rr_edge_sizes, num_rows: int, axis_name,
+                   halo_dtype):
+    """Σ_d (round-d scatter-add of Â_halo·recv_d) over the ppermute ring."""
+    remote = jnp.zeros((num_rows, x.shape[-1]), x.dtype)
+    off_s = off_e = 0
+    for d, (sd, ed) in enumerate(zip(rr_sizes, rr_edge_sizes), start=1):
+        if sd == 0:                       # no pair at this ring distance
+            off_e += ed
+            continue
+        buf = jnp.take(x, rsend_idx[off_s: off_s + sd], axis=0)  # (S_d, f)
+        if halo_dtype is not None:
+            buf = buf.astype(halo_dtype)                         # wire only
+        recv = ppermute_or_identity(buf, axis_name, d).astype(x.dtype)
+        g = (jnp.take(recv, redge_src[off_e: off_e + ed], axis=0)
+             * redge_w[off_e: off_e + ed, None])
+        remote = remote.at[redge_dst[off_e: off_e + ed]].add(
+            g, indices_are_sorted=True)
+        off_s += sd
+        off_e += ed
+    return remote
+
+
+def _pspmm_ragged_once(h, rsend_idx, ell_idx, ell_w,
+                       ltail_dst, ltail_src, ltail_w,
+                       redge_dst, redge_src, redge_w,
+                       buckets, rr_sizes, rr_edge_sizes, axis_name,
+                       halo_dtype):
+    # local ELL aggregation has no data dependence on ANY round (overlap)
+    local = spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, h, buckets)
+    remote = _ragged_remote(h, rsend_idx, redge_dst, redge_src, redge_w,
+                            rr_sizes, rr_edge_sizes, h.shape[0], axis_name,
+                            halo_dtype)
+    return local + remote
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(10, 11, 12, 13, 14))
+def pspmm_ragged_sym(h, rsend_idx, ell_idx, ell_w,
+                     ltail_dst, ltail_src, ltail_w,
+                     redge_dst, redge_src, redge_w,
+                     buckets, rr_sizes, rr_edge_sizes,
+                     axis_name=AXIS, halo_dtype=None):
+    """``PSpMM`` over the ragged ppermute ring for a SYMMETRIC Â.
+
+    Same math as ``pspmm_ell_sym`` — ELL local aggregation plus the halo
+    contribution — but the exchange is k−1 per-round-sized ppermutes
+    instead of one globally-padded all_to_all, and the remote term folds
+    round by round (see ``_ragged_remote``).  The custom backward reuses
+    the forward form on ``g`` (Âᵀg = Âg for symmetric Â): the gradient
+    rides the same ragged ring, same per-round sizes, same narrow-wire
+    ``halo_dtype`` lever — the ragged analogue of the reference's swapped
+    send/recv backward maps (``GPU/PGCN.py:93-97``).
+
+    Only valid when ``plan.symmetric``; the trainer gates on it.
+    """
+    return _pspmm_ragged_once(h, rsend_idx, ell_idx, ell_w,
+                              ltail_dst, ltail_src, ltail_w,
+                              redge_dst, redge_src, redge_w,
+                              buckets, rr_sizes, rr_edge_sizes, axis_name,
+                              halo_dtype)
+
+
+def _pspmm_ragged_sym_fwd(h, rsend_idx, ell_idx, ell_w,
+                          ltail_dst, ltail_src, ltail_w,
+                          redge_dst, redge_src, redge_w,
+                          buckets, rr_sizes, rr_edge_sizes, axis_name,
+                          halo_dtype):
+    out = _pspmm_ragged_once(h, rsend_idx, ell_idx, ell_w,
+                             ltail_dst, ltail_src, ltail_w,
+                             redge_dst, redge_src, redge_w,
+                             buckets, rr_sizes, rr_edge_sizes, axis_name,
+                             halo_dtype)
+    res = (rsend_idx, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+           redge_dst, redge_src, redge_w)
+    return out, res
+
+
+def _pspmm_ragged_sym_bwd(buckets, rr_sizes, rr_edge_sizes, axis_name,
+                          halo_dtype, res, g):
+    (rsend_idx, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+     redge_dst, redge_src, redge_w) = res
+    gh = _pspmm_ragged_once(g, rsend_idx, ell_idx, ell_w,
+                            ltail_dst, ltail_src, ltail_w,
+                            redge_dst, redge_src, redge_w,
+                            buckets, rr_sizes, rr_edge_sizes, axis_name,
+                            halo_dtype)
+    return (gh, *[None] * 9)
+
+
+pspmm_ragged_sym.defvjp(_pspmm_ragged_sym_fwd, _pspmm_ragged_sym_bwd)
 
 
 # --------------------------------------------------------------------- stale

@@ -57,7 +57,14 @@ PER_CHIP_ARRAY_FIELDS = (
     "cell_idx", "cell_w", "ctail_dst", "ctail_src", "ctail_w", "ctail_nnz",
     "ptile_lsrc", "ptile_lld", "ptile_lw",
     "ptile_hsrc", "ptile_hld", "ptile_hw",
+    "rsend_idx", "rhalo_dst", "redge_dst", "redge_src", "redge_w",
 )
+
+# Auto-selection threshold for SGCN_COMM_SCHEDULE=auto: below this dense-a2a
+# padding efficiency (Σ send_counts / (k²·S)) the per-round-sized ragged
+# ppermute ring ships strictly fewer wire bytes by a margin worth its k−1
+# rounds; above it the single dense all_to_all's one-shot latency wins.
+RAGGED_AUTO_EFFICIENCY = 0.5
 
 # Global-vertex-indexed arrays (plus the proxy's chip-identity record):
 # pass through a per-chip slice untouched.
@@ -176,6 +183,28 @@ class CommPlan:
     ptile_hld: np.ndarray | None = None   # (k, T, EmaxH) int32
     ptile_hw: np.ndarray | None = None    # (k, T, EmaxH) float32
 
+    # Ragged ppermute-ring exchange layout (lazy, ``ensure_ragged``): the
+    # reference's point-to-point halo protocol re-expressed as k−1 rounds of
+    # ``lax.ppermute`` where round d carries chip p → chip (p+d)%k in a
+    # buffer statically sized to S_d = max_p send_counts[p, (p+d)%k] — a
+    # PER-ROUND pad instead of the dense all_to_all's global S, so skewed
+    # partitions stop paying k²·S wire slots for a Σ(λ−1) exchange.  All
+    # round segments are flattened along the trailing axis (round d's slots
+    # start at Σ_{d'<d} S_{d'}); ``rr_sizes``/``rr_edge_sizes`` are the
+    # static per-round offsets the op unrolls over (rounds with S_d = 0 are
+    # skipped at trace time).  ``redge_*`` is the halo-src edge family split
+    # per owner (= per round) at plan time with src re-based to the round's
+    # receive buffer — the fold-as-you-arrive structure of the reference's
+    # post-Irecv accumulate loop (``Parallel-GCN/main.c:238-299``).
+    rr_sizes: tuple | None = None        # (k-1,) static per-round send size S_d
+    rr_edge_sizes: tuple | None = None   # (k-1,) static per-round edge pad
+    rsend_idx: np.ndarray | None = None  # (k, ΣS_d) int32 local rows to ship
+    rhalo_dst: np.ndarray | None = None  # (k, ΣS_d) int32 halo rank per recv
+    #                                      slot (r = padding, dropped)
+    redge_dst: np.ndarray | None = None  # (k, ΣE_d) int32 local dst row
+    redge_src: np.ndarray | None = None  # (k, ΣE_d) int32 round recv-buffer row
+    redge_w: np.ndarray | None = None    # (k, ΣE_d) float32, 0 on padding
+
     # identities of the chips this (possibly sliced) plan's rows describe —
     # set by the shard proxy (``parallel/proxy.py``) so the comm-stat
     # properties zero each row's TRUE self-slot rather than assuming row i
@@ -239,6 +268,148 @@ class CommPlan:
                 max_buckets=max_buckets))
             for name, val in fields.items():
                 setattr(self, name, val)
+        return self
+
+    # -------------------------------------------------------- ragged schedule
+    def ragged_round_sizes(self) -> tuple:
+        """Natural per-round send sizes S_d = max_p send_counts[p, (p+d)%k]
+        for d = 1..k−1 — the static buffer sizes of the ragged ppermute ring
+        (round d carries chip p → chip (p+d)%k).  Needs the full square
+        plan; a shard-proxy slice keeps the tuple built before slicing."""
+        sc = np.asarray(self.send_counts)
+        if sc.ndim != 2 or sc.shape[0] != sc.shape[1]:
+            raise ValueError(
+                f"ragged_round_sizes needs the full square plan "
+                f"(send_counts {sc.shape}); build the ragged layout with "
+                "ensure_ragged() BEFORE shard_proxy_plan slicing")
+        k = sc.shape[0]
+        idx = np.arange(k)
+        return tuple(int(sc[idx, (idx + d) % k].max()) for d in range(1, k))
+
+    def padding_efficiency(self) -> float:
+        """Σ send_counts / (k²·S): the fraction of the dense all_to_all's
+        padded wire slots that carry real boundary rows.  The auto-select
+        gauge of ``SGCN_COMM_SCHEDULE=auto`` (``RAGGED_AUTO_EFFICIENCY``)
+        and the ``padding_efficiency`` field of the obs event stream.  On a
+        shard-proxy slice the numerator covers the rows in view and the
+        denominator scales with them, so the figure stays comparable."""
+        wire = self.wire_rows_per_exchange("a2a")
+        return float(self.send_counts.sum()) / wire if wire else 1.0
+
+    def wire_rows_per_exchange(self, schedule: str = "a2a") -> int:
+        """Padded rows the selected schedule puts on the wire per exchange,
+        over the chips in view (full plan: all k).  Dense a2a ships the
+        whole (k, S) buffer per chip = k²·S rows; the ragged ring ships
+        Σ_d S_d rows per chip = k·Σ_d S_d — the padded-vs-true accounting
+        the roofline and CommStats report against ``predicted_send_volume``
+        (= Σ(λ−1), the true rows)."""
+        rows, peers = np.asarray(self.send_counts).shape
+        if schedule == "a2a":
+            return int(rows * peers * self.s)
+        if schedule == "ragged":
+            sizes = (self.rr_sizes if self.rr_sizes is not None
+                     else self.ragged_round_sizes())
+            return int(rows * sum(sizes))
+        raise ValueError(f"unknown comm schedule {schedule!r}")
+
+    def ensure_ragged(self, rr_sizes: tuple | None = None,
+                      rr_edge_sizes: tuple | None = None) -> "CommPlan":
+        """Build the ragged ppermute-ring layout on first use.
+
+        ``rr_sizes`` / ``rr_edge_sizes`` force larger per-round envelopes
+        (the mini-batch trainer pads every batch plan to shared round sizes
+        so one compiled step serves all batches, like ``pad_comm_plan``).
+
+        Receive-side invariant: the plan's halo order is (owner, vertex) and
+        each send list p→q is id-sorted, so round d's received rows land
+        EXACTLY in chip q's contiguous per-owner halo slice, in order — the
+        per-round edge split (``redge_*``) therefore re-bases hedge src
+        straight to the round's receive buffer, and because ``hedge_*`` is
+        sorted by (dst, round, recv-pos) at build time, folding round
+        contributions into the output accumulator in round order applies
+        per-row updates in the SAME sequence as the dense path's single
+        halo-src segment-sum — the f32 bit-parity contract of the two
+        schedules (tests/test_ragged.py).
+        """
+        if (self.rr_sizes is not None
+                and rr_sizes in (None, self.rr_sizes)
+                and rr_edge_sizes in (None, self.rr_edge_sizes)):
+            return self
+        nat_sizes = self.ragged_round_sizes()
+        k, s, r = self.k, self.s, self.r
+        sc = np.asarray(self.send_counts)
+        if rr_sizes is None:
+            rr_sizes = nat_sizes
+        elif (len(rr_sizes) != len(nat_sizes)
+                or any(a < b for a, b in zip(rr_sizes, nat_sizes))):
+            raise ValueError(
+                f"forced rr_sizes {rr_sizes} smaller than natural "
+                f"{nat_sizes}")
+        rr_sizes = tuple(int(x) for x in rr_sizes)
+        owner_rank = np.asarray(self.halo_src) // s       # (k, R) owner per
+        pos_rank = np.asarray(self.halo_src) % s          # halo rank + pos
+        st = max(1, sum(rr_sizes))
+        rsend_idx = np.zeros((k, st), np.int32)
+        rhalo_dst = np.full((k, st), r, np.int32)         # r = dropped pad
+        off = 0
+        for d, sd in enumerate(rr_sizes, start=1):
+            for p in range(k):
+                cnt = int(sc[p, (p + d) % k])             # send side: p → p+d
+                rsend_idx[p, off: off + cnt] = self.send_idx[p, (p + d) % k,
+                                                             :cnt]
+                o = (p - d) % k                           # recv side: o → p
+                rc = int(sc[o, p])
+                if rc:
+                    hs = int(self.halo_counts[p])
+                    ranks = np.nonzero(owner_rank[p, :hs] == o)[0]
+                    if len(ranks) != rc:                  # plan invariant
+                        raise ValueError(
+                            f"halo sublist of owner {o} on chip {p} has "
+                            f"{len(ranks)} rows, send list says {rc}")
+                    rhalo_dst[p, off: off + rc] = ranks.astype(np.int32)
+            off += sd
+        # per-round halo-src edge families: hedge is (dst, round, pos)-sorted
+        # at build time, so each round's subsequence is (dst, pos)-sorted
+        per_chip_rounds: list[list] = []
+        for q in range(k):
+            cnt = int(self.hnnz[q])
+            d_ = self.hedge_dst[q, :cnt]
+            s_ = self.hedge_src[q, :cnt]
+            w_ = self.hedge_w[q, :cnt]
+            fold = (q - owner_rank[q, s_]) % k            # arrival round
+            per_chip_rounds.append(
+                [(d_[fold == d], pos_rank[q, s_[fold == d]], w_[fold == d])
+                 for d in range(1, k)])
+        nat_es = tuple(
+            max((len(per_chip_rounds[q][d][0]) for q in range(k)), default=0)
+            for d in range(max(k - 1, 0)))
+        if rr_edge_sizes is None:
+            rr_edge_sizes = nat_es
+        elif (len(rr_edge_sizes) != len(nat_es)
+                or any(a < b for a, b in zip(rr_edge_sizes, nat_es))):
+            raise ValueError(
+                f"forced rr_edge_sizes {rr_edge_sizes} smaller than natural "
+                f"{nat_es}")
+        rr_edge_sizes = tuple(int(x) for x in rr_edge_sizes)
+        et = max(1, sum(rr_edge_sizes))
+        redge_dst = np.full((k, et), self.b - 1, np.int32)
+        redge_src = np.zeros((k, et), np.int32)
+        redge_w = np.zeros((k, et), np.float32)
+        off = 0
+        for d, ed in enumerate(rr_edge_sizes):
+            for q in range(k):
+                dd, ss, ww = per_chip_rounds[q][d]
+                redge_dst[q, off: off + len(dd)] = dd
+                redge_src[q, off: off + len(ss)] = ss
+                redge_w[q, off: off + len(ww)] = ww
+            off += ed
+        self.rr_sizes = rr_sizes
+        self.rr_edge_sizes = rr_edge_sizes
+        self.rsend_idx = rsend_idx
+        self.rhalo_dst = rhalo_dst
+        self.redge_dst = redge_dst
+        self.redge_src = redge_src
+        self.redge_w = redge_w
         return self
 
     # ------------------------------------------------------------ stale halo
@@ -323,6 +494,52 @@ class CommPlan:
         return np.asarray(blocks)[self.owner, self.local_idx]
 
 
+def resolve_comm_schedule(schedule: str | None, plans, model: str,
+                          halo_staleness: int = 0,
+                          fin: int | None = None, widths=None) -> str:
+    """Resolve a ``comm_schedule`` knob to a concrete transport — THE one
+    selection rule shared by both trainers (a second copy would drift).
+
+    ``None`` reads ``$SGCN_COMM_SCHEDULE`` (default ``'a2a'``).  ``'auto'``
+    is a PREFERENCE: it picks ``'ragged'`` only when every plan supports it
+    (GCN, symmetric, exact mode, full square counts or a pre-built ragged
+    layout, k > 1), the aggregate dense padding efficiency
+    Σ send_counts / Σ k²·S falls below ``RAGGED_AUTO_EFFICIENCY``, AND the
+    choice does not forfeit the Pallas VMEM aggregator — the ragged fold is
+    pinned to the ELL path, so in the VMEM regime (``use_pallas_spmm``) the
+    kernel's measured win outweighs the wire padding and a2a stays.
+    Everything else resolves to ``'a2a'`` silently.  An explicit
+    ``'ragged'`` is a CONTRACT — callers validate it loudly themselves.
+    """
+    import os
+    if schedule is None:
+        schedule = os.environ.get("SGCN_COMM_SCHEDULE", "a2a")
+    if schedule not in ("a2a", "ragged", "auto"):
+        raise ValueError(
+            f"comm_schedule must be 'a2a', 'ragged' or 'auto', got "
+            f"{schedule!r}")
+    if schedule != "auto":
+        return schedule
+    if model != "gcn" or halo_staleness:
+        return "a2a"
+    true = wire = 0
+    for p in plans:
+        sc = np.asarray(p.send_counts)
+        ragged_ready = (p.rr_sizes is not None
+                        or (sc.ndim == 2 and sc.shape[0] == sc.shape[1]))
+        if not (p.symmetric and ragged_ready and sc.shape[1] > 1):
+            return "a2a"
+        true += int(sc.sum())
+        wire += p.wire_rows_per_exchange("a2a")
+    if not wire or true / wire >= RAGGED_AUTO_EFFICIENCY:
+        return "a2a"
+    if fin is not None and widths is not None:
+        from ..ops.pallas_spmm import use_pallas_spmm   # deferred: jax
+        if use_pallas_spmm(plans[0], fin, widths):
+            return "a2a"
+    return "ragged"
+
+
 def _relabel(n: int, partvec: np.ndarray, k: int, pad_rows_to: int,
              order_key: np.ndarray | None = None):
     """Shared vertex relabeling: (owner, local_idx, part_sizes, b, row_valid).
@@ -355,13 +572,23 @@ def _relabel(n: int, partvec: np.ndarray, k: int, pad_rows_to: int,
 
 
 def _split_edges(edge_dst, edge_src, edge_w, nnz, b,
-                 el: int | None = None, eh: int | None = None):
+                 el: int | None = None, eh: int | None = None,
+                 halo_fold_key=None):
     """Split padded (k, E) edge lists into local-src and halo-src lists.
 
     Local edges (``src < b``) keep their src; halo edges re-base src to the
     halo block (``src - b``).  Filtering preserves the sorted-by-dst
     invariant.  ``el`` / ``eh`` force a larger padded width (shared
     compilation envelopes); padding edges carry dst ``b-1`` and weight 0.
+
+    ``halo_fold_key`` (optional, (k, R) int): per-chip fold position of each
+    halo rank — the ragged ring's arrival round ``(chip − owner) mod k``.
+    When given, each chip's halo edges are re-sorted by (dst, fold, rank) so
+    the dense halo-src segment-sum applies per-row updates in the SAME
+    sequence as the ragged schedule's round-order fold — the f32 bit-parity
+    contract between the two exchange schedules (``CommPlan.ensure_ragged``).
+    Within a (dst, round) run the rank order equals the receive-buffer
+    order, so each round's subsequence stays (dst, pos)-sorted too.
     """
     k = edge_dst.shape[0]
     parts = []
@@ -369,7 +596,12 @@ def _split_edges(edge_dst, edge_src, edge_w, nnz, b,
         cnt = int(nnz[p])
         d, s0, w = edge_dst[p, :cnt], edge_src[p, :cnt], edge_w[p, :cnt]
         lm = s0 < b
-        parts.append((d[lm], s0[lm], w[lm], d[~lm], s0[~lm] - b, w[~lm]))
+        hd, hs, hw = d[~lm], s0[~lm] - b, w[~lm]
+        if halo_fold_key is not None and len(hd):
+            fk = halo_fold_key[p]
+            o = np.lexsort((hs, fk[hs], hd))
+            hd, hs, hw = hd[o], hs[o], hw[o]
+        parts.append((d[lm], s0[lm], w[lm], hd, hs, hw))
     lnnz = np.array([len(t[0]) for t in parts], dtype=np.int64)
     hnnz = np.array([len(t[3]) for t in parts], dtype=np.int64)
     el_nat = max(1, int(lnnz.max()) if k else 1)
@@ -706,7 +938,11 @@ def pad_comm_plan(plan: CommPlan, b: int, s: int, r: int, e: int,
     row_valid = np.zeros((k, b), dtype=np.float32)
     row_valid[:, : plan.b] = plan.row_valid
 
-    split = _split_edges(edge_dst, edge_src, edge_w, plan.nnz, b, el=el, eh=eh)
+    chips = (np.asarray(plan.chip_ids) if plan.chip_ids is not None
+             else np.arange(k))
+    peers = plan.send_counts.shape[1]
+    split = _split_edges(edge_dst, edge_src, edge_w, plan.nnz, b, el=el, eh=eh,
+                         halo_fold_key=(chips[:, None] - halo_src // s) % peers)
     ell = _build_ell(split["ledge_dst"], split["ledge_src"], split["ledge_w"],
                      split["lnnz"], b, row_order=plan.row_order,
                      buckets=ell_buckets, tl=tl)
@@ -837,7 +1073,9 @@ def build_comm_plan(
         edge_src[p, :cnt] = csrc[srt]
         edge_w[p, :cnt] = vals[srt]
 
-    split = _split_edges(edge_dst, edge_src, edge_w, nnz, b)
+    split = _split_edges(edge_dst, edge_src, edge_w, nnz, b,
+                         halo_fold_key=(np.arange(k)[:, None]
+                                        - halo_src // s) % k)
     ell = _build_ell(split["ledge_dst"], split["ledge_src"], split["ledge_w"],
                      split["lnnz"], b, row_order=row_order)
     return CommPlan(

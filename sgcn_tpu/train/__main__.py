@@ -71,6 +71,16 @@ def main() -> None:
                    help="stale mode: run a full-sync (exact-math) step "
                         "every N steps to bound staleness/quantization "
                         "drift; 0 = only the initializing first step")
+    p.add_argument("--comm-schedule", default=None,
+                   choices=["a2a", "ragged", "auto"],
+                   help="halo transport (docs/comm_schedule.md): a2a = "
+                        "dense globally-padded all_to_all (default); "
+                        "ragged = per-round-sized ppermute ring (same "
+                        "math, bit-identical f32 losses, fewer wire bytes "
+                        "on skewed partitions; GCN + symmetric adjacency); "
+                        "auto = ragged when the plan's padding efficiency "
+                        "drops below 0.5.  Default: $SGCN_COMM_SCHEDULE, "
+                        "else a2a")
     p.add_argument("--epochs", type=int, default=4)
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--lr", type=float, default=0.01)
@@ -140,6 +150,15 @@ def main() -> None:
         raise SystemExit(
             "--halo-delta/--sync-every configure the stale pipelined "
             "exchange; add --halo-staleness 1")
+    if args.comm_schedule == "ragged" and (args.model != "gcn"
+                                           or args.halo_staleness
+                                           or args.experiment == "accuracy"):
+        raise SystemExit(
+            "--comm-schedule ragged drives the full-batch/mini-batch GCN "
+            "halo exchange only (GAT ships attention tables over the dense "
+            "a2a; composition with --halo-staleness 1 is deferred; the "
+            "accuracy-parity harness is defined for the default transport) "
+            "— drop the conflicting flag or use --comm-schedule auto")
 
     if args.metrics_out:
         # before any heavy import: heartbeat() in the launch/backend layers
@@ -263,7 +282,8 @@ def main() -> None:
                                   batch_size=args.batch_size, lr=args.lr,
                                   model=args.model, loss=args.loss,
                                   activation=activation, seed=args.seed,
-                                  compute_dtype=args.dtype)
+                                  compute_dtype=args.dtype,
+                                  comm_schedule=args.comm_schedule)
             if recorder is not None:
                 recorder.set_partitioner({"partvec": args.partvec, "k": k})
                 tr.attach_recorder(recorder)
@@ -283,7 +303,8 @@ def main() -> None:
                                   halo_dtype=args.halo_dtype,
                                   halo_staleness=args.halo_staleness,
                                   halo_delta=args.halo_delta,
-                                  sync_every=args.sync_every)
+                                  sync_every=args.sync_every,
+                                  comm_schedule=args.comm_schedule)
             if recorder is not None:
                 recorder.set_plan(plan, partitioner={"partvec": args.partvec,
                                                      "k": k})

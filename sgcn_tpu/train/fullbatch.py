@@ -203,6 +203,7 @@ class FullBatchTrainer:
         halo_staleness: int = 0,
         halo_delta: bool = False,
         sync_every: int = 0,
+        comm_schedule: str | None = None,
     ):
         """``compute_dtype='bfloat16'`` runs forward/backward (including the
         halo exchange — half the ICI bytes) in bf16 with f32 master params
@@ -240,7 +241,18 @@ class FullBatchTrainer:
         wire bytes (the gradient wire stays at ``halo_dtype``).  ``0``
         (default) is EXACTLY the pre-existing trainer — same code path, same
         program.  GCN + symmetric Â only; evaluation always runs the exact
-        forward."""
+        forward.
+
+        ``comm_schedule`` selects the halo transport
+        (``docs/comm_schedule.md``): ``'a2a'`` (default) is the dense
+        globally-padded ``all_to_all``; ``'ragged'`` the per-round-sized
+        ppermute ring (``ops/pspmm.py::pspmm_ragged_sym``) — same math, f32
+        bit-identical losses, strictly fewer wire bytes whenever
+        ``send_counts`` is skewed; ``'auto'`` picks ragged when the plan's
+        dense padding efficiency falls below ``RAGGED_AUTO_EFFICIENCY``
+        (``parallel/plan.py``).  ``None`` reads ``$SGCN_COMM_SCHEDULE``
+        (default ``'a2a'``).  GCN + symmetric Â only; composition with
+        ``halo_staleness=1`` is deferred (clean error)."""
         if halo_dtype is not None and model != "gcn":
             raise ValueError(
                 "halo_dtype is a GCN-trainer lever; for GAT use "
@@ -276,6 +288,34 @@ class FullBatchTrainer:
                     "halo_staleness=1 is defined for the f32 non-remat "
                     "trainer (carries are f32 state threaded through the "
                     "step); drop compute_dtype/remat or run exact mode")
+        # ONE selection rule for both trainers (parallel/plan.py): 'auto'
+        # silently prefers ragged on skewed plans unless that forfeits the
+        # Pallas VMEM aggregator; an explicit 'ragged' is a contract,
+        # validated loudly below
+        from ..parallel.plan import resolve_comm_schedule
+        comm_schedule = resolve_comm_schedule(
+            comm_schedule, [plan], model, halo_staleness,
+            fin=fin, widths=list(widths))
+        if comm_schedule == "ragged":
+            if model != "gcn":
+                raise ValueError(
+                    "comm_schedule='ragged' drives the GCN halo exchange; "
+                    "the GAT exchange ships per-layer attention tables over "
+                    "the dense a2a — drop the flag or use 'auto'")
+            if not plan.symmetric:
+                raise ValueError(
+                    "comm_schedule='ragged' uses the symmetric-Â custom "
+                    "backward (the gradient rides the same ppermute ring); "
+                    "this plan is asymmetric — run the a2a schedule")
+            if halo_staleness:
+                raise ValueError(
+                    "comm_schedule='ragged' does not compose with "
+                    "halo_staleness=1 yet: the stale carry contract "
+                    "(pspmm_stale) is built around the dense a2a wire — "
+                    "run one lever or the other (deferred composition, "
+                    "docs/comm_schedule.md)")
+            plan.ensure_ragged()
+        self.comm_schedule = comm_schedule
         self.halo_staleness = halo_staleness
         self.halo_delta = halo_delta
         self.sync_every = sync_every
@@ -301,7 +341,18 @@ class FullBatchTrainer:
         init_fn, self._forward_fn, fields_fn, static_fn = MODELS[model]
         self.plan_fields = fields_fn(plan)
         self._fwd_static = static_fn(plan)   # e.g. the ELL bucket structure
-        if model == "gcn" and not halo_staleness:
+        if model == "gcn" and comm_schedule == "ragged":
+            # the ragged schedule stays on the ELL aggregator (its fold
+            # contract is built around the per-owner edge split; the Pallas
+            # tile layout is a dense-a2a companion) — mirror of the stale
+            # mode's aggregator pin below
+            from ..models.gcn import GCN_PLAN_FIELDS_RAGGED
+            self.plan_fields = GCN_PLAN_FIELDS_RAGGED
+            self._fwd_static = {"ell_buckets": plan.ell_buckets,
+                                "comm_schedule": "ragged",
+                                "rr_sizes": plan.rr_sizes,
+                                "rr_edge_sizes": plan.rr_edge_sizes}
+        if model == "gcn" and not halo_staleness and comm_schedule == "a2a":
             # plan-driven kernel choice (VERDICT r3 #9): per-chip tables in
             # the VMEM regime switch the aggregator to the Pallas kernel.
             # The stale mode stays on the ELL aggregator: pspmm_stale's
@@ -350,7 +401,7 @@ class FullBatchTrainer:
             for f in ("cell_w", "ctail_w"):
                 arrays[f] = (arrays[f] != 0).astype(np.int8)
         self.pa = shard_stacked(self.mesh, arrays)
-        self.stats = CommStats.from_plan(plan)
+        self.stats = CommStats.from_plan(plan, schedule=comm_schedule)
         self._step = self._build_step()
         self._eval = self._build_eval()
         self._multi = {}        # epochs -> compiled on-device epoch loop
@@ -793,7 +844,8 @@ class FullBatchTrainer:
                     self.plan, self.fin, self.widths,
                     compute_dtype=self.compute_dtype,
                     wire_itemsize=2 if (self.halo_dtype == "bfloat16"
-                                        or self.halo_delta) else None)
+                                        or self.halo_delta) else None,
+                    comm_schedule=self.comm_schedule)
             ex_step = 2 * self.nlayers      # this step's exchanges
             exposed_step = 0 if (drift is not None
                                  and not drift.get("sync_step")) else ex_step

@@ -84,6 +84,7 @@ class MiniBatchTrainer:
         seed: int = 0,
         pad_rows_to: int = 8,
         compute_dtype: str | None = None,
+        comm_schedule: str | None = None,
     ):
         self.a = sp.csr_matrix(a)
         n = self.a.shape[0]
@@ -127,12 +128,35 @@ class MiniBatchTrainer:
             for p in self.plans:
                 p.symmetric = False
 
+        # one compiled step serves every batch, so the ragged per-round
+        # envelope must be SHARED across batch plans, exactly like the
+        # B/S/R/E envelope above: resolve the schedule over the whole batch
+        # set (the shared rule in parallel/plan.py), then pad every plan's
+        # round sizes to the elementwise max
+        from ..parallel.plan import resolve_comm_schedule
+        comm_schedule = resolve_comm_schedule(
+            comm_schedule, self.plans, model, fin=fin, widths=list(widths))
+        if comm_schedule == "ragged":
+            # EVERY plan needs the layout (the fused sweep stacks the ragged
+            # arrays across batches), padded to the shared round envelope;
+            # k=1 plans have zero rounds and stack trivially
+            for p in self.plans:
+                p.ensure_ragged()
+            if k > 1:
+                shared_s = tuple(int(x) for x in np.max(
+                    [p.rr_sizes for p in self.plans], axis=0))
+                shared_e = tuple(int(x) for x in np.max(
+                    [p.rr_edge_sizes for p in self.plans], axis=0))
+                for p in self.plans:
+                    p.ensure_ragged(rr_sizes=shared_s,
+                                    rr_edge_sizes=shared_e)
+
         # one inner trainer = one compiled step for every batch
         self.inner = FullBatchTrainer(
             self.plans[0], fin, widths, mesh=self.mesh, lr=lr,
             activation=activation, model=model, loss=loss,
             optimizer=optimizer, seed=seed,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, comm_schedule=comm_schedule)
         self.nlayers = len(widths)
         self._fullgraph_eval = None   # built lazily, cached across calls
         self.recorder = None          # run telemetry (sgcn_tpu.obs)
@@ -162,18 +186,30 @@ class MiniBatchTrainer:
         if self._comm_cum is None:
             self._comm_cum = {
                 "arrs": [np.zeros_like(p, dtype=np.int64) for p in per],
-                "exchanges": 0, "send_volume": 0,
+                "exchanges": 0, "send_volume": 0, "wire_rows": 0,
             }
         c = self._comm_cum
         for acc, p in zip(c["arrs"], per):
             acc += p.astype(np.int64) * d
         c["exchanges"] += d
         c["send_volume"] += int(per[0].sum()) * d
+        c["wire_rows"] += stats.wire_rows_per_exchange * d
         rep = CommStats.report_from_cumulative(*c["arrs"])
         rep.update(                 # mini-batch steps are never pipelined
             exchanges=c["exchanges"],
             exposed_exchanges=c["exchanges"], hidden_exchanges=0,
             exposed_send_volume=c["send_volume"], hidden_send_volume=0,
+            # the same wire gauges the full-batch snapshot carries
+            # (docs/observability.md): the per-exchange figures are the
+            # CURRENT batch's (wire is uniform — all batch plans share one
+            # padded envelope; true rows vary per batch), the cumulative
+            # ones cover every recorded step
+            comm_schedule=stats.schedule,
+            true_rows_per_exchange=int(per[0].sum()),
+            wire_rows_per_exchange=stats.wire_rows_per_exchange,
+            wire_rows_total=c["wire_rows"],
+            padding_efficiency=(c["send_volume"] / c["wire_rows"]
+                                if c["wire_rows"] else 1.0),
         )
         return rep
 
@@ -191,7 +227,8 @@ class MiniBatchTrainer:
                 pa=shard_stacked(self.mesh,
                                  _plan_arrays(plan, self.inner.plan_fields)),
                 data=TrainData(**shard_stacked(self.mesh, vars(data))),
-                stats=CommStats.from_plan(plan),
+                stats=CommStats.from_plan(
+                    plan, schedule=self.inner.comm_schedule),
             ))
         return out
 
@@ -357,7 +394,9 @@ class MiniBatchTrainer:
         # same 8-number comm accounting as the stepwise path (one counter
         # set per batch plan, merged on report)
         if not hasattr(self, "_fused_stats"):
-            self._fused_stats = [CommStats.from_plan(p) for p in self.plans]
+            self._fused_stats = [
+                CommStats.from_plan(p, schedule=self.inner.comm_schedule)
+                for p in self.plans]
         for _ in range(epochs):
             for st in self._fused_stats:
                 st.count_step(nlayers=self.nlayers)

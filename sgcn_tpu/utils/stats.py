@@ -31,9 +31,20 @@ class CommStats:
     # hidden behind local compute).  The volume still crosses the wire —
     # hence one total and a hidden/exposed split, never two totals.
     hidden_exchanges: int = 0
+    # Padded-vs-true accounting of the SELECTED exchange schedule
+    # (docs/comm_schedule.md): the send/recv volumes above count TRUE
+    # boundary rows (Σ(λ−1), what the partitioner minimizes); the schedule
+    # ships a statically padded superset — k²·S rows for the dense a2a,
+    # Σ_d k·S_d for the ragged ppermute ring.  One true count, one wire
+    # count, never a blended number.
+    schedule: str = "a2a"
+    wire_rows_per_exchange: int = 0        # padded rows on the wire (global
+    #                                        over the chips in view)
+    padding_efficiency: float = 1.0        # true / wire of the SELECTED
+    #                                        schedule
 
     @classmethod
-    def from_plan(cls, plan) -> "CommStats":
+    def from_plan(cls, plan, schedule: str = "a2a") -> "CommStats":
         off = plan.offwire_send_counts()
         send_vol = plan.predicted_send_volume.astype(np.int64)
         send_msg = plan.predicted_message_count.astype(np.int64)
@@ -52,12 +63,17 @@ class CommStats:
                     "!= send, so recv counters cannot be derived; proxy a "
                     "symmetric plan or build stats from the full plan")
             recv_vol, recv_msg = send_vol, send_msg
+        wire = int(plan.wire_rows_per_exchange(schedule))
+        true = int(send_vol.sum())
         return cls(
             k=plan.k,
             send_volume_per_exchange=send_vol,
             send_msgs_per_exchange=send_msg,
             recv_volume_per_exchange=recv_vol,
             recv_msgs_per_exchange=recv_msg,
+            schedule=schedule,
+            wire_rows_per_exchange=wire,
+            padding_efficiency=(true / wire if wire else 1.0),
         )
 
     def count_step(self, nlayers: int, hidden: bool = False) -> None:
@@ -111,6 +127,15 @@ class CommStats:
             hidden_exchanges=self.hidden_exchanges,
             exposed_send_volume=per_ex * exposed,
             hidden_send_volume=per_ex * self.hidden_exchanges,
+            # per-schedule padded-vs-true accounting: true rows are what the
+            # partitioner optimizes, wire rows what the schedule ships; the
+            # obs roofline must agree with these EXACTLY
+            # (tests/test_metrics_cli.py)
+            comm_schedule=self.schedule,
+            true_rows_per_exchange=per_ex,
+            wire_rows_per_exchange=self.wire_rows_per_exchange,
+            wire_rows_total=self.wire_rows_per_exchange * self.exchanges,
+            padding_efficiency=self.padding_efficiency,
         )
         return rep
 
@@ -131,6 +156,9 @@ class CommStats:
         rep = CommStats.report_from_cumulative(*sums)
         exchanges = sum(s.exchanges for s in stats_list)
         hidden = sum(s.hidden_exchanges for s in stats_list)
+        schedules = {s.schedule for s in stats_list} or {"a2a"}
+        wire_total = sum(s.wire_rows_per_exchange * s.exchanges
+                         for s in stats_list)
         rep.update(
             exchanges=exchanges,
             exposed_exchanges=exchanges - hidden,
@@ -141,5 +169,13 @@ class CommStats:
             hidden_send_volume=sum(
                 int(s.send_volume_per_exchange.sum()) * s.hidden_exchanges
                 for s in stats_list),
+            # cross-counter wire accounting: each counter's wire rows are
+            # its OWN plan's (per-batch envelopes differ), so totals sum per
+            # counter; efficiency is the cumulative true/wire ratio
+            comm_schedule=(schedules.pop() if len(schedules) == 1
+                           else "mixed"),
+            wire_rows_total=wire_total,
+            padding_efficiency=(rep["total_send_volume"] / wire_total
+                                if wire_total else 1.0),
         )
         return rep

@@ -37,8 +37,10 @@ SUBPROCESS_BUDGET_ALLOWLIST = {
                           "snapshot; no mesh, no training",
     "test_real_datasets.py": "k=4 CLI train on the committed cora fixture "
                              "(k=8 variant IS slow-marked)",
-    "test_metrics_cli.py": "one --metrics-out + --profile trainer child on "
-                           "the small cora fixture (the telemetry smoke)",
+    "test_metrics_cli.py": "two trainer children on the small cora fixture "
+                           "(--metrics-out + --profile telemetry smoke, and "
+                           "the ragged-schedule wire-reconciliation smoke; "
+                           "~50 s together)",
     "test_validate_bench.py": "two validate_bench.py CLI children — pure "
                               "stdlib JSON checks, sub-second, no jax",
 }

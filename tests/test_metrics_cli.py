@@ -124,6 +124,75 @@ def test_drift_gauges_present_and_finite(telemetry_run):
     assert max(ages) <= 2                   # --sync-every 2 bounds the age
 
 
+@pytest.fixture(scope="module")
+def ragged_run(tmp_path_factory):
+    """A second CLI child on the cora fixture under the RAGGED schedule
+    (exact mode) — with the module's stale/a2a child above, --metrics-out
+    has run under both transports, the gauge-reconciliation smoke of the
+    comm-schedule work (docs/comm_schedule.md)."""
+    d = tmp_path_factory.mktemp("obs_ragged")
+    metrics = str(d / "run")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "-m", "sgcn_tpu.train",
+         "--npz", os.path.join(FIX, "cora_like.npz"),
+         "-p", os.path.join(FIX, "cora_like.4.hp"),
+         "-b", "cpu", "-s", "4", "-l", "2", "--normalize",
+         "--epochs", "2", "--warmup", "1",
+         "--comm-schedule", "ragged", "--metrics-out", metrics],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
+    assert r.returncode == 0, r.stderr
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    return metrics, report
+
+
+def _assert_wire_reconciles(metrics, report):
+    """CommStats' printed report and the obs events must agree on wire
+    accounting EXACTLY — rows, bytes, efficiency, schedule."""
+    from sgcn_tpu.obs import load_run
+
+    log = load_run(metrics)
+    steps = log.steps()
+    for ev in steps:
+        comm, roof = ev["comm"], ev["roofline"]
+        assert comm["comm_schedule"] == roof["comm_schedule"]
+        assert comm["wire_rows_per_exchange"] == \
+            roof["halo_wire_rows_per_exchange"]
+        assert comm["padding_efficiency"] == roof["padding_efficiency"]
+        # bytes are rows × Σ layer widths × itemsize × 2 on BOTH sides of
+        # the split, so the true/wire byte ratio must equal the true/wire
+        # ROW ratio the CommStats side reports — byte-for-byte, no slack
+        assert (roof["halo_bytes_wire_per_step"]
+                * comm["true_rows_per_exchange"]
+                == roof["halo_bytes_true_per_step"]
+                * comm["wire_rows_per_exchange"])
+        assert roof["halo_bytes_wire_per_step"] >= \
+            roof["halo_bytes_true_per_step"]
+    last = steps[-1]["comm"]
+    for key in ("comm_schedule", "wire_rows_per_exchange", "wire_rows_total",
+                "true_rows_per_exchange", "padding_efficiency"):
+        assert last[key] == report[key], (key, last[key], report[key])
+
+
+def test_wire_gauges_reconcile_under_both_schedules(telemetry_run,
+                                                    ragged_run):
+    """The satellite contract: --metrics-out under BOTH schedules, CommStats
+    report and obs events agreeing on wire bytes exactly; the ragged run's
+    wire strictly below the dense run's at equal true volume."""
+    _, metrics_a2a, report_a2a = telemetry_run
+    metrics_rag, report_rag = ragged_run
+    _assert_wire_reconciles(metrics_a2a, report_a2a)
+    _assert_wire_reconciles(metrics_rag, report_rag)
+    assert report_a2a["comm_schedule"] == "a2a"
+    assert report_rag["comm_schedule"] == "ragged"
+    assert report_a2a["true_rows_per_exchange"] == \
+        report_rag["true_rows_per_exchange"]
+    assert report_rag["wire_rows_per_exchange"] < \
+        report_a2a["wire_rows_per_exchange"]
+
+
 def test_obs_report_renders(telemetry_run):
     _, metrics, _ = telemetry_run
     r = subprocess.run(

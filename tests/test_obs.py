@@ -53,7 +53,16 @@ def test_commstats_conservation_and_report():
         "max_send_msgs", "total_recv_volume", "max_recv_volume",
         "total_recv_msgs", "max_recv_msgs", "exchanges",
         "exposed_exchanges", "hidden_exchanges", "exposed_send_volume",
-        "hidden_send_volume"}
+        "hidden_send_volume",
+        # the padded-vs-true wire split of the selected exchange schedule
+        # (docs/comm_schedule.md)
+        "comm_schedule", "true_rows_per_exchange", "wire_rows_per_exchange",
+        "wire_rows_total", "padding_efficiency"}
+    # wire accounting defaults to the dense a2a schedule and reconciles
+    assert rep["comm_schedule"] == "a2a"
+    assert rep["true_rows_per_exchange"] == per_ex
+    assert rep["wire_rows_per_exchange"] >= per_ex
+    assert rep["wire_rows_total"] == 14 * rep["wire_rows_per_exchange"]
 
 
 def test_commstats_merged_report_matches_manual_sum():
@@ -268,4 +277,10 @@ def test_step_cost_model_and_roofline():
     assert roof["stream_ceiling_frac"] == float(
         f"{cost.gather_bytes / 0.01 / 1e9 / STREAM_CEILING_GBS:.4g}")
     assert roof["exposed_comm_frac"] == 0.25
-    assert roof["exposed_halo_bytes"] == cost.halo_bytes_per_step // 4
+    # exposed bytes charge the WIRE volume of the selected schedule (the
+    # padded slots cross ICI too — docs/comm_schedule.md), not the Σ(λ−1)
+    # true volume the pre-split model under-counted with
+    assert roof["exposed_halo_bytes"] == cost.halo_bytes_wire_per_step // 4
+    assert roof["halo_bytes_true_per_step"] == cost.halo_bytes_per_step
+    assert roof["halo_bytes_wire_per_step"] >= roof["halo_bytes_true_per_step"]
+    assert roof["comm_schedule"] == "a2a"

@@ -1,0 +1,116 @@
+"""Plan-contract lint (tier-1): the field-name tuples that ship plan arrays
+to devices must stay in sync with the ``CommPlan`` dataclass itself.
+
+The PR-2 shard-proxy incident class: a new per-chip plan field that is not
+classified in ``PER_CHIP_ARRAY_FIELDS`` mis-slices (or loudly fails) under
+``shard_proxy_plan``, and a consumer tuple naming a field that no longer
+exists only explodes at trainer-construction time deep in a run.  This lint
+fails the commit that introduces either skew — including for the ragged
+exchange fields, covered from day one.
+"""
+
+import dataclasses
+
+import numpy as np
+
+from sgcn_tpu.io.datasets import er_graph
+from sgcn_tpu.models.gat import GAT_PLAN_FIELDS
+from sgcn_tpu.models.gcn import (GCN_PLAN_FIELDS_GEN, GCN_PLAN_FIELDS_RAGGED,
+                                 GCN_PLAN_FIELDS_SYM)
+from sgcn_tpu.ops.pallas_spmm import PALLAS_PLAN_FIELDS
+from sgcn_tpu.parallel import build_comm_plan
+from sgcn_tpu.parallel.plan import (_GLOBAL_ARRAY_FIELDS,
+                                    PER_CHIP_ARRAY_FIELDS, CommPlan)
+from sgcn_tpu.partition import balanced_random_partition
+from sgcn_tpu.prep import normalize_adjacency
+
+# every tuple that names CommPlan fields for shipping/slicing, in one place
+CONSUMER_TUPLES = {
+    "PER_CHIP_ARRAY_FIELDS": PER_CHIP_ARRAY_FIELDS,
+    "_GLOBAL_ARRAY_FIELDS": _GLOBAL_ARRAY_FIELDS,
+    "PALLAS_PLAN_FIELDS": PALLAS_PLAN_FIELDS,
+    "GAT_PLAN_FIELDS": GAT_PLAN_FIELDS,
+    "GCN_PLAN_FIELDS_SYM": GCN_PLAN_FIELDS_SYM,
+    "GCN_PLAN_FIELDS_GEN": GCN_PLAN_FIELDS_GEN,
+    "GCN_PLAN_FIELDS_RAGGED": GCN_PLAN_FIELDS_RAGGED,
+}
+
+
+def _full_plan():
+    """A k=4 plan with EVERY lazy layout built (cell, pallas tiles, ragged),
+    n ≠ k so a shape coincidence cannot mask a misclassification."""
+    n, k = 200, 4
+    ahat = normalize_adjacency(er_graph(n, 6, seed=0))
+    pv = balanced_random_partition(n, k, seed=1)
+    plan = build_comm_plan(ahat, pv, k)
+    plan.ensure_cell()
+    plan.ensure_pallas_tiles(tb=64)
+    plan.ensure_ragged()
+    return plan
+
+
+def test_every_tuple_names_real_dataclass_fields():
+    names = {f.name for f in dataclasses.fields(CommPlan)}
+    for tup_name, tup in CONSUMER_TUPLES.items():
+        unknown = [f for f in tup if f not in names]
+        assert not unknown, (
+            f"{tup_name} names non-existent CommPlan fields {unknown} — "
+            "the tuple and the dataclass have drifted apart")
+
+
+def test_every_array_field_is_classified():
+    """Every ndarray field of a fully-built plan is either per-chip-stacked
+    (classified + leading k axis) or global — nothing unclassified, nothing
+    misclassified."""
+    plan = _full_plan()
+    for f in dataclasses.fields(plan):
+        v = getattr(plan, f.name)
+        if not isinstance(v, np.ndarray):
+            continue
+        if f.name in PER_CHIP_ARRAY_FIELDS:
+            assert v.shape[0] == plan.k, (
+                f"CommPlan.{f.name} is classified per-chip but has shape "
+                f"{v.shape} (k={plan.k})")
+        elif f.name in _GLOBAL_ARRAY_FIELDS:
+            continue
+        else:
+            raise AssertionError(
+                f"CommPlan.{f.name} is an ndarray field classified in "
+                "NEITHER PER_CHIP_ARRAY_FIELDS nor _GLOBAL_ARRAY_FIELDS — "
+                "the shard proxy cannot know how to slice it")
+
+
+def test_shipped_field_tuples_are_sliceable():
+    """Every field a model forward ships must survive the shard proxy: the
+    arrays the trainers put on devices are exactly the ones the proxy must
+    slice per chip."""
+    from sgcn_tpu.parallel.proxy import shard_proxy_plan
+
+    plan = _full_plan()
+    proxy = shard_proxy_plan(plan, chip=1)      # raises on any drift
+    for tup_name in ("PALLAS_PLAN_FIELDS", "GAT_PLAN_FIELDS",
+                     "GCN_PLAN_FIELDS_SYM", "GCN_PLAN_FIELDS_GEN",
+                     "GCN_PLAN_FIELDS_RAGGED"):
+        for f in CONSUMER_TUPLES[tup_name]:
+            v = getattr(plan, f)
+            assert isinstance(v, np.ndarray), (
+                f"{tup_name}: {f} not materialized on a fully-built plan")
+            assert f in PER_CHIP_ARRAY_FIELDS, (
+                f"{tup_name}: shipped field {f} is not per-chip-classified "
+                "— shard_map would misshard it")
+            assert getattr(proxy, f).shape == (1,) + v.shape[1:], f
+
+
+def test_ragged_fields_covered_on_day_one():
+    """The PR-4 fields specifically: classified, built by ensure_ragged,
+    named by the ragged forward tuple."""
+    ragged_arrays = ("rsend_idx", "rhalo_dst", "redge_dst", "redge_src",
+                     "redge_w")
+    for f in ragged_arrays:
+        assert f in PER_CHIP_ARRAY_FIELDS, f
+    plan = _full_plan()
+    for f in ragged_arrays:
+        assert isinstance(getattr(plan, f), np.ndarray), f
+    assert isinstance(plan.rr_sizes, tuple)
+    assert isinstance(plan.rr_edge_sizes, tuple)
+    assert set(GCN_PLAN_FIELDS_RAGGED) <= set(PER_CHIP_ARRAY_FIELDS)

@@ -15,7 +15,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from validate_bench import (check_bench_record, check_multichip_record,  # noqa: E402
-                            check_products_ksweep, validate_tree)
+                            check_products_ksweep, check_ragged_ab,
+                            validate_tree)
 
 
 def test_checked_in_artifacts_validate():
@@ -58,6 +59,45 @@ def test_validator_enforces_pow2_rb_constraint():
                                   "hp_rb": {"km1": 4, "time_s": 1.0}},
                            "8": {"hp": {"km1": 7, "time_s": 1.0}}}}}
     assert not check_products_ksweep(ok)
+
+
+def _rab_entry(**over):
+    e = {"epoch_s_a2a": 0.03, "epoch_s_ragged": 0.02,
+         "padding_efficiency": 0.4, "padded_true_ratio_a2a": 2.5,
+         "wire_rows_a2a": 1000, "wire_rows_ragged": 600, "true_rows": 400}
+    e.update(over)
+    return e
+
+
+def test_validator_ragged_ab_contract():
+    """The a2a-vs-ragged A/B block: null needs a degradation marker; a
+    config's per-round wire rows can never exceed the global pad, its
+    padded/true ratio never drop below 1 (both are hand-edit tells)."""
+    assert any("ragged_ab_degraded" in e for e in check_ragged_ab(
+        {"ragged_ab_8dev": None}))
+    assert not check_ragged_ab({"ragged_ab_8dev": None,
+                                "ragged_ab_degraded": "deadline"})
+    ok = {"ragged_ab_8dev": {"random": _rab_entry(), "hp": _rab_entry()}}
+    assert not check_ragged_ab(ok)
+    bad_wire = {"ragged_ab_8dev": {
+        "hp": _rab_entry(wire_rows_ragged=2000)}}
+    assert any("global pad" in e for e in check_ragged_ab(bad_wire))
+    bad_ratio = {"ragged_ab_8dev": {
+        "hp": _rab_entry(padded_true_ratio_a2a=0.8)}}
+    assert any("below 1" in e for e in check_ragged_ab(bad_ratio))
+    bad_pe = {"ragged_ab_8dev": {"hp": _rab_entry(padding_efficiency=1.7)}}
+    assert any("padding_efficiency" in e for e in check_ragged_ab(bad_pe))
+    assert any("no random/hp" in e
+               for e in check_ragged_ab({"ragged_ab_8dev": {}}))
+
+
+def test_validator_rejects_unresolved_comm_schedule():
+    rec = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+           "parsed": {"metric": "m", "value": 1.0, "unit": "s",
+                      "comm_schedule": "auto"}}
+    assert any("resolved schedule" in e for e in check_bench_record(rec))
+    rec["parsed"]["comm_schedule"] = "ragged"
+    assert not check_bench_record(rec)
 
 
 def test_validator_rejects_nonstandard_json(tmp_path):
