@@ -237,19 +237,19 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
                   sync_every=sync_every)
         part_metrics.update(halo_staleness=halo_staleness,
                             halo_delta=halo_delta, sync_every=sync_every)
-    if comm_schedule is not None and model == "gcn":
+    if comm_schedule is not None:
         kw["comm_schedule"] = comm_schedule
     trainer = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths,
                                mesh=mesh, compute_dtype=dtype, remat=remat,
                                **kw)
-    if model == "gcn":
-        # padded-vs-true accounting of the SELECTED transport (the resolved
-        # schedule when 'auto' was asked; docs/comm_schedule.md)
-        part_metrics["comm_schedule"] = trainer.comm_schedule
-        part_metrics["padding_efficiency"] = round(
-            trainer.stats.padding_efficiency, 6)
-        part_metrics["wire_rows_per_exchange"] = \
-            trainer.stats.wire_rows_per_exchange
+    # padded-vs-true accounting of the SELECTED transport (the resolved
+    # schedule when 'auto' was asked; docs/comm_schedule.md) — both models
+    # ship a transport now, so both report it
+    part_metrics["comm_schedule"] = trainer.comm_schedule
+    part_metrics["padding_efficiency"] = round(
+        trainer.stats.padding_efficiency, 6)
+    part_metrics["wire_rows_per_exchange"] = \
+        trainer.stats.wire_rows_per_exchange
     data = make_train_data(plan, feats, labels)
     data = type(data)(**shard_stacked(mesh, vars(data)))
     # DIFFERENTIAL timing (round-3 protocol, see diff_time): the reference's
@@ -298,7 +298,8 @@ def bench_jax(ahat, feats, labels, widths, epochs: int, model: str = "gcn",
             # gathers 2-byte lanes
             from sgcn_tpu.obs.attribution import (roofline_fields, step_cost)
             cost = step_cost(plan, feats.shape[1], widths,
-                             compute_dtype=dtype)
+                             compute_dtype=dtype,
+                             comm_schedule=trainer.comm_schedule)
             roof = roofline_fields(cost, epoch_s)
             part_metrics["gather_GB_per_epoch_per_chip"] = round(
                 cost.gather_bytes / 1e9, 3)
@@ -613,35 +614,42 @@ def bench_stale_ab_child(ahat, feats, labels, widths, epochs: int,
 
 
 def bench_ragged_ab(n: int, avg_deg: int, f: int, widths, epochs: int,
-                    graph: str = "ba"):
+                    graph: str = "ba", model: str = "gcn"):
     """A/B the dense a2a vs the ragged ppermute-ring schedule on the
     8-virtual-device CPU mesh, across one BALANCED (random) and one SKEWED
     (native hp) partition of the same power-law graph — the configs where
     the padded/true ratio differs most (docs/comm_schedule.md).  One child
     process runs all four arms over shared process state (the
     between-process variance lesson of ``bench_stale_ab``).  Degrades to a
-    marked partial block on child failure."""
-    block: dict = {"ragged_ab_8dev": None}
+    marked partial block on child failure.  ``model='gat'`` runs the SAME
+    harness with the GAT trainer (the ``gat_ragged_ab_8dev`` block): the
+    ring then carries the ``(fout+1)``-lane attention tables in both
+    exchange directions."""
+    prefix = "ragged_ab" if model == "gcn" else "gat_ragged_ab"
+    block: dict = {f"{prefix}_8dev": None}
     try:
         child = _run_vdev_child(n, avg_deg, f, widths, epochs, graph,
-                                extra_args=("--ragged-ab-child",))
+                                extra_args=(f"--{prefix.replace('_', '-')}"
+                                            "-child",))
         child.pop("metric", None)
         child.pop("value", None)
-        block["ragged_ab_8dev"] = child
+        block[f"{prefix}_8dev"] = child
         return block
     except subprocess.TimeoutExpired:
-        print("# ragged A/B run exceeded its deadline", file=sys.stderr)
-        block["ragged_ab_degraded"] = "deadline"
+        print(f"# {model} ragged A/B run exceeded its deadline",
+              file=sys.stderr)
+        block[f"{prefix}_degraded"] = "deadline"
         return block
     except Exception as e:                      # noqa: BLE001 — diagnostic path
-        print(f"# ragged A/B run failed: {e!r}", file=sys.stderr)
-        block["ragged_ab_degraded"] = repr(e)[:200]
+        print(f"# {model} ragged A/B run failed: {e!r}", file=sys.stderr)
+        block[f"{prefix}_degraded"] = repr(e)[:200]
         return block
 
 
 def bench_ragged_ab_child(ahat, feats, labels, widths, epochs: int,
-                          graph: str) -> dict:
-    """One-process a2a-vs-ragged A/B (the ``--ragged-ab-child`` body).
+                          graph: str, model: str = "gcn") -> dict:
+    """One-process a2a-vs-ragged A/B (the ``--ragged-ab-child`` /
+    ``--gat-ragged-ab-child`` body).
 
     Per partition (balanced random, skewed hp): one plan, one mesh, both
     schedule trainers; rep-level PAIRED differentials exactly like
@@ -649,7 +657,10 @@ def bench_ragged_ab_child(ahat, feats, labels, widths, epochs: int,
     separately timed phases); per-step dispatch so neither arm hides
     behind the fused sweep.  Each config emits the padded/true wire-row
     ratio next to its timings — the quantity the ragged schedule exists to
-    shrink."""
+    shrink.  The wire-row win on the skewed partition is ASSERTED here (and
+    re-checked by ``scripts/validate_bench.py``): epoch speed on the
+    virtual CPU mesh is reported honestly but never the claim — no ICI, so
+    the byte win is the TPU-relevant figure."""
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -662,7 +673,7 @@ def bench_ragged_ab_child(ahat, feats, labels, widths, epochs: int,
 
     k = len(jax.devices())
     n = ahat.shape[0]
-    out: dict = {"n": n, "graph": graph, "k": k,
+    out: dict = {"n": n, "graph": graph, "k": k, "model": model,
                  "timing": "per-step dispatch, one process, rep-level "
                            "paired differentials (see paired_differential)"}
     parts: list[tuple[str, np.ndarray, int | None]] = [
@@ -672,6 +683,8 @@ def bench_ragged_ab_child(ahat, feats, labels, widths, epochs: int,
         parts.append(("hp", pv_hp, int(km1)))
     mesh = make_mesh_1d(k)
     nep = max(6, epochs)
+    model_kw = ({"model": "gat", "activation": "none"}
+                if model == "gat" else {})
     for name, pv, km1 in parts:
         plan = build_comm_plan(ahat, pv, k)
         plan.ensure_ragged()
@@ -680,7 +693,8 @@ def bench_ragged_ab_child(ahat, feats, labels, widths, epochs: int,
 
         def arm(schedule):
             tr = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths,
-                                  mesh=mesh, comm_schedule=schedule)
+                                  mesh=mesh, comm_schedule=schedule,
+                                  **model_kw)
 
             def make_run(n_ep):
                 def run():
@@ -692,10 +706,17 @@ def bench_ragged_ab_child(ahat, feats, labels, widths, epochs: int,
             return make_run
 
         a2a_s, rag_s, clean = paired_differential(
-            arm("a2a"), arm("ragged"), nep, what=f"ragged A/B ({name})")
+            arm("a2a"), arm("ragged"), nep,
+            what=f"{model} ragged A/B ({name})")
         true = int(plan.predicted_send_volume.sum())
         wire_a2a = plan.wire_rows_per_exchange("a2a")
         wire_rag = plan.wire_rows_per_exchange("ragged")
+        if name == "hp" and not wire_rag < wire_a2a:
+            # the acceptance invariant of the schedule: per-round pads must
+            # beat the global pad on the skewed partition
+            raise RuntimeError(
+                f"{model} ragged A/B (hp): wire_rows_ragged={wire_rag} not "
+                f"below wire_rows_a2a={wire_a2a}")
         cfg = {
             "epoch_s_a2a": round(a2a_s, 6),
             "epoch_s_ragged": round(rag_s, 6),
@@ -937,6 +958,13 @@ def main() -> None:
                    help="graph size for the ragged A/B child (one extra "
                         "CPU-mesh run covering a balanced-random and a "
                         "skewed hp partition)")
+    p.add_argument("--skip-gat-ragged-ab", action="store_true",
+                   help="skip the GAT a2a-vs-ragged schedule A/B on the "
+                        "virtual 8-device mesh")
+    p.add_argument("--gat-ragged-ab-n", type=int, default=15_000,
+                   help="graph size for the GAT ragged A/B child (one "
+                        "extra CPU-mesh run; smaller than --ragged-ab-n — "
+                        "the attention tables make the arms heavier)")
     p.add_argument("--step-dispatch", action="store_true",
                    help="time one step() dispatch per epoch instead of the "
                         "fused on-device epoch loop (the stale A/B timing "
@@ -974,15 +1002,15 @@ def main() -> None:
                    help=argparse.SUPPRESS)
     p.add_argument("--ragged-ab-child", action="store_true",
                    help=argparse.SUPPRESS)
+    p.add_argument("--gat-ragged-ab-child", action="store_true",
+                   help=argparse.SUPPRESS)
     args = p.parse_args()
 
-    if args.comm_schedule == "ragged" and (args.model != "gcn"
-                                           or args.halo_staleness):
+    if args.comm_schedule == "ragged" and args.halo_staleness:
         # never measure one transport while the JSON claims another
         raise SystemExit(
-            "--comm-schedule ragged drives the GCN exact exchange only "
-            "(GAT ships attention tables over the dense a2a; composition "
-            "with --halo-staleness 1 is deferred)")
+            "--comm-schedule ragged drives the exact exchange only "
+            "(composition with --halo-staleness 1 is deferred)")
     if (args.halo_delta or args.sync_every) and not args.halo_staleness:
         # match the trainer CLI: silently measuring exact mode while the
         # JSON reader believes it was the delta wire would be a lie
@@ -1013,6 +1041,15 @@ def main() -> None:
             "value": None,      # the per-partition blocks are the payload
             **bench_ragged_ab_child(ahat, feats, labels, widths, args.epochs,
                                     graph=args.graph),
+        }))
+        return
+
+    if args.gat_ragged_ab_child:
+        print(json.dumps({
+            "metric": "gat_ragged_ab",
+            "value": None,      # the per-partition blocks are the payload
+            **bench_ragged_ab_child(ahat, feats, labels, widths, args.epochs,
+                                    graph=args.graph, model="gat"),
         }))
         return
 
@@ -1114,6 +1151,14 @@ def main() -> None:
             vdev_metrics.update(bench_ragged_ab(
                 args.ragged_ab_n, args.avg_deg, args.f, widths,
                 max(2, args.epochs // 2), graph=args.vdev_graph))
+        if (args.model == "gcn" and args.halo_staleness == 0
+                and not args.skip_gat_ragged_ab):
+            # the GAT schedule A/B rides the same diagnostic sweep (the
+            # gat flagship path skips vdev entirely, so it runs here)
+            vdev_metrics.update(bench_ragged_ab(
+                args.gat_ragged_ab_n, args.avg_deg, args.f, widths,
+                max(2, args.epochs // 2), graph=args.vdev_graph,
+                model="gat"))
     extra = {}
     if not args.vdev_child:
         extra.update(products_partition_block())

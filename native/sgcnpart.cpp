@@ -1137,6 +1137,19 @@ int sgcn_partition_hypergraph(i32 ncells, i32 nnets, const i64* cellptr,
     const bool pow2 = (k & (k - 1)) == 0;
     const bool use_rb = pow2 && rb_env != nullptr ? rb_env[0] == '1'
                         : pow2 && k >= 32;
+    // Post-RB polish runs on a compact_nets'd COPY of the fine hypergraph
+    // (ADVICE r5): a column-net hypergraph of an undirected graph carries
+    // every net twice (mirror pairs), so identical-net merging halves the
+    // O(deg·k) gain scans of the direct k-way passes while the weighted
+    // km1 objective — and therefore every move decision's gain — stays
+    // exactly the original km1 (the ml path already refines compacted
+    // levels for the same reason).  Built once, reused across restarts;
+    // cells are untouched by compaction, so the part vector carries over.
+    Hypergraph hpol;
+    if (use_rb) {
+      hpol = h;
+      compact_nets(hpol);
+    }
     for (int r = 0; r < restarts; ++r) {
       if (use_rb)
         partition_hypergraph_rb(h, k, imbalance, seed + 7919 * r, cand);
@@ -1145,8 +1158,8 @@ int sgcn_partition_hypergraph(i32 ncells, i32 nnets, const i64* cellptr,
       double cap = (1.0 + imbalance) * (double)h.total_cwgt / k;
       if (use_rb) {
         // one direct k-way polish pass: RB never saw cross-side moves
-        rebalance_km1(h, k, cap, cand);
-        refine_km1(h, k, cap, cand, 2);
+        rebalance_km1(hpol, k, cap, cand);
+        refine_km1(hpol, k, cap, cand, 2);
       }
       build_pincounts(h, cand, pc);
       i64 score = km1_total(h, pc);

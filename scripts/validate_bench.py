@@ -24,13 +24,16 @@ What counts as a violation:
   * **non-standard JSON**: ``NaN``/``Infinity`` tokens — ``json.dumps``
     emits them for non-finite floats, but they are not valid JSON and no
     checked-in artifact may carry them;
-  * **ragged-schedule accounting** (PR-4): a flagship result carrying
-    ``comm_schedule`` must name a resolved schedule (never ``auto``); a
-    ``ragged_ab_8dev`` A/B block must either be a per-partition dict whose
-    configs carry positive timings, ``padding_efficiency`` in (0, 1], a
-    padded/true ratio ≥ 1, and ``wire_rows_ragged ≤ wire_rows_a2a``
-    (per-round pads can never exceed the global pad — a violation is a
-    hand-edit tell), or be ``null`` WITH a ``ragged_ab_degraded`` marker;
+  * **ragged-schedule accounting** (PR-4; GAT flavor PR-5): a flagship
+    result carrying ``comm_schedule`` must name a resolved schedule (never
+    ``auto``); a ``ragged_ab_8dev`` / ``gat_ragged_ab_8dev`` A/B block must
+    either be a per-partition dict whose configs carry positive timings,
+    ``padding_efficiency`` in (0, 1], a padded/true ratio ≥ 1, and
+    ``wire_rows_ragged ≤ wire_rows_a2a`` (per-round pads can never exceed
+    the global pad — a violation is a hand-edit tell; the GAT block's hp
+    config must win STRICTLY — the satellite's acceptance figure, asserted
+    on wire rows, never epoch speed), or be ``null`` WITH a matching
+    ``*_degraded`` marker;
   * **the pow2-k RB constraint** (``products_ksweep.json``): ``hp_rb``
     entries at non-power-of-two k, or k < 32.  The PR-2 review incident:
     ``partition_hypergraph_rb`` recurses on k/2 and the auto-select
@@ -104,49 +107,61 @@ def check_bench_record(rec: dict) -> list[str]:
                         "resolve before emission)")
         if "ragged_ab_8dev" in parsed:
             errs += check_ragged_ab(parsed)
+        if "gat_ragged_ab_8dev" in parsed:
+            errs += check_ragged_ab(parsed, prefix="gat_ragged_ab")
     return errs
 
 
-def check_ragged_ab(parsed: dict) -> list[str]:
-    """The a2a-vs-ragged A/B block contract (see module docstring)."""
+def check_ragged_ab(parsed: dict, prefix: str = "ragged_ab") -> list[str]:
+    """The a2a-vs-ragged A/B block contract (see module docstring); the
+    same rules validate the GCN block (``ragged_ab_8dev``) and the GAT one
+    (``gat_ragged_ab_8dev``, PR-5).  The GAT block additionally requires a
+    STRICT wire-row win on the skewed hp partition — the satellite's
+    acceptance figure (never epoch speed: the virtual mesh has no ICI)."""
     errs = []
-    block = parsed["ragged_ab_8dev"]
+    name = f"{prefix}_8dev"
+    block = parsed[name]
     if block is None:
-        if not isinstance(parsed.get("ragged_ab_degraded"), str):
-            errs.append("ragged_ab_8dev null without a ragged_ab_degraded "
+        if not isinstance(parsed.get(f"{prefix}_degraded"), str):
+            errs.append(f"{name} null without a {prefix}_degraded "
                         "marker (graceful-degradation contract)")
         return errs
     if not isinstance(block, dict):
-        return [f"ragged_ab_8dev is {type(block).__name__}, expected "
+        return [f"{name} is {type(block).__name__}, expected "
                 "dict or null"]
     configs = [c for c in ("random", "hp") if c in block]
     if not configs:
-        return ["ragged_ab_8dev carries no random/hp partition config"]
+        return [f"{name} carries no random/hp partition config"]
     for cfg in configs:
         e = block[cfg]
         if not isinstance(e, dict):
-            errs.append(f"ragged_ab_8dev.{cfg} is not a dict")
+            errs.append(f"{name}.{cfg} is not a dict")
             continue
         for key in ("epoch_s_a2a", "epoch_s_ragged"):
             if not (_is_num(e.get(key)) and e[key] > 0):
-                errs.append(f"ragged_ab_8dev.{cfg}.{key}={e.get(key)!r}")
+                errs.append(f"{name}.{cfg}.{key}={e.get(key)!r}")
         pe = e.get("padding_efficiency")
         if not (_is_num(pe) and 0 < pe <= 1):
-            errs.append(f"ragged_ab_8dev.{cfg}: padding_efficiency={pe!r} "
+            errs.append(f"{name}.{cfg}: padding_efficiency={pe!r} "
                         "outside (0, 1]")
         ratio = e.get("padded_true_ratio_a2a")
         if ratio is not None and not (_is_num(ratio) and ratio >= 1):
-            errs.append(f"ragged_ab_8dev.{cfg}: padded_true_ratio_a2a="
+            errs.append(f"{name}.{cfg}: padded_true_ratio_a2a="
                         f"{ratio!r} below 1 (padding cannot shrink the "
                         "true volume)")
         wa, wr = e.get("wire_rows_a2a"), e.get("wire_rows_ragged")
         if not (_is_num(wa) and _is_num(wr) and wr <= wa):
-            errs.append(f"ragged_ab_8dev.{cfg}: wire_rows_ragged={wr!r} "
+            errs.append(f"{name}.{cfg}: wire_rows_ragged={wr!r} "
                         f"exceeds wire_rows_a2a={wa!r} — per-round pads "
                         "can never exceed the global pad")
+        if (prefix == "gat_ragged_ab" and cfg == "hp"
+                and _is_num(wa) and _is_num(wr) and not wr < wa):
+            errs.append(f"{name}.hp: wire_rows_ragged={wr!r} not STRICTLY "
+                        f"below wire_rows_a2a={wa!r} on the skewed "
+                        "partition — the schedule's acceptance figure")
         tr = e.get("true_rows")
         if _is_num(tr) and _is_num(wr) and tr > wr:
-            errs.append(f"ragged_ab_8dev.{cfg}: true_rows={tr!r} above "
+            errs.append(f"{name}.{cfg}: true_rows={tr!r} above "
                         f"wire_rows_ragged={wr!r}")
     return errs
 

@@ -30,7 +30,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from ..ops.pspmm import a2a_or_identity, halo_exchange
+from ..ops.pspmm import (a2a_or_identity, halo_exchange, halo_exchange_ragged,
+                         halo_exchange_ragged_multi)
 from ..parallel.mesh import AXIS
 from .activations import get_activation
 
@@ -38,8 +39,52 @@ from .activations import get_activation
 # the bucketed combined-edge layout plus its hub tail
 GAT_PLAN_FIELDS = ("send_idx", "halo_src", "cell_idx", "cell_w",
                    "ctail_dst", "ctail_src", "ctail_w", "row_valid")
+# Under comm_schedule='ragged' the dense (k, S) send buckets are swapped for
+# the per-round ppermute-ring layout (CommPlan.ensure_ragged) — the
+# rsend_idx/rhalo_dst split is per-VERTEX and model-independent, so GAT
+# reuses the exact arrays the GCN ring rides; only the table riding them
+# (the (fout+1)-lane attention table) differs.
+GAT_PLAN_FIELDS_RAGGED = ("rsend_idx", "rhalo_dst", "cell_idx", "cell_w",
+                          "ctail_dst", "ctail_src", "ctail_w", "row_valid")
+
+# static comm spec threaded through the layer stack: ('a2a',) selects the
+# dense all_to_all, ('ragged', rr_sizes, r) the per-round ppermute ring —
+# hashable, so it rides custom_vjp's nondiff_argnums
+COMM_A2A = ("a2a",)
 
 _NEG = -1e30
+
+
+def gat_exchange_lane_widths(widths, compute_dtype: str | None = None):
+    """Per-layer wire width of the GAT attention-table exchange, in
+    f32-LANE equivalents — THE shared lane model for every byte-accounting
+    consumer (``obs.attribution.step_cost``, ``CommStats`` — the
+    schedule-selection ratio needs no lanes: they cancel, see
+    ``resolve_comm_schedule``); change the forward's table forms and this
+    together.
+
+    Per layer (both exchange directions ship the same table shape):
+
+      * f32 fused table ``[p ‖ u]``: ``fout + 1`` lanes;
+      * f32 split pair (``fout`` features + 1 scalar, whether as the a2a's
+        two dense dispatches or one two-lane ragged ring): the SAME
+        ``fout + 1`` lanes across its buffers;
+      * bf16 packed (even ``fout``): the bit-paired ``fout/2 + 1`` f32
+        lanes;
+      * bf16 unpacked (odd ``fout``): a ``(fout+1)``-lane bf16 table =
+        ``(fout+1)/2`` f32-lane equivalents.
+
+    Expressing narrow dtypes as f32-lane equivalents keeps one itemsize (4)
+    for every downstream byte figure.
+    """
+    out = []
+    for fout in widths:
+        fout = int(fout)
+        if compute_dtype == "bfloat16":
+            out.append(fout // 2 + 1 if fout % 2 == 0 else (fout + 1) // 2)
+        else:
+            out.append(fout + 1)
+    return out
 
 
 def init_gat_params(rng: jax.Array, dims: list[tuple[int, int]]):
@@ -90,6 +135,7 @@ def gat_layer_local(
     row_valid=None,               # (B,) 1/0 — real vs pad rows
     buckets=((1, 1),),            # static ((nb, wb), ...) of cell layout
     axis_name: str = AXIS,
+    comm=COMM_A2A,                # static transport spec (_exchange_table)
 ):
     """One sharded GAT layer for GENERAL (possibly asymmetric) edge
     patterns: the factored forward of ``gat_layer_sym`` with autodiff
@@ -112,14 +158,14 @@ def gat_layer_local(
         row_valid = jnp.ones((h.shape[0],), jnp.float32)
     out, _, _, _, _ = _gat_factored_fwd_core(
         w, a2, h, send_idx, halo_src, cell_idx, cell_w,
-        ctail_dst, ctail_src, ctail_w, row_valid, buckets, axis_name)
+        ctail_dst, ctail_src, ctail_w, row_valid, buckets, axis_name, comm)
     return out
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(12, 13))
+@partial(jax.custom_vjp, nondiff_argnums=(12, 13, 14))
 def gat_layer_sym(w, a1, a2, h, send_idx, halo_src, cell_idx, cell_w,
                   ctail_dst, ctail_src, ctail_w, row_valid, buckets,
-                  axis_name=AXIS):
+                  axis_name=AXIS, comm=COMM_A2A):
     """``gat_layer_local`` in FACTORIZED form with a gather-only backward,
     for SYMMETRIC edge patterns (undirected graphs — the standing case).
 
@@ -152,7 +198,7 @@ def gat_layer_sym(w, a1, a2, h, send_idx, halo_src, cell_idx, cell_w,
     """
     out, _, _, _, _ = _gat_factored_fwd_core(
         w, a2, h, send_idx, halo_src, cell_idx, cell_w,
-        ctail_dst, ctail_src, ctail_w, row_valid, buckets, axis_name)
+        ctail_dst, ctail_src, ctail_w, row_valid, buckets, axis_name, comm)
     return out
 
 
@@ -250,16 +296,41 @@ def _fused_form(fout: int) -> bool:
     return fout + 1 <= 128
 
 
-def _exchange_rows_scalar(p, u, send_idx, halo_src, axis_name):
+def _exchange_table(table, send_idx, halo_src, axis_name, comm=COMM_A2A):
+    """Ship one boundary row table over the SELECTED transport and return
+    its (R, d) halo block — the single dispatch point of the GAT exchange
+    (``docs/comm_schedule.md``).  Under ``('a2a',)`` ``send_idx``/
+    ``halo_src`` are the plan's dense ``(k, S)`` layout; under
+    ``('ragged', rr_sizes, r)`` they are ``rsend_idx``/``rhalo_dst`` and
+    the table rides the per-round-sized ppermute ring.  Halo rows are
+    bit-identical either way (the ragged scatter writes each real slot
+    exactly once), so every slot pass downstream is schedule-blind."""
+    if comm[0] == "ragged":
+        return halo_exchange_ragged(table, send_idx, halo_src,
+                                    comm[1], comm[2], axis_name)
+    return halo_exchange(table, send_idx, halo_src, axis_name)
+
+
+def _exchange_rows_scalar(p, u, send_idx, halo_src, axis_name,
+                          comm=COMM_A2A):
     """Exchange feature rows AND a per-row scalar without ever building a
-    ``(B, fout+1)``-lane table: the scalar rides its own (k, S) buffer
-    (second all_to_all of negligible bytes), dodging the 2× tile-padding
-    tax a 129-lane f32 array pays.  Returns the concatenated
-    ``[local; halo]`` pair ``(full_p (B+R, fout), full_u (B+R,))``."""
-    halo_p = halo_exchange(p, send_idx, halo_src, axis_name)
-    buf_u = jnp.take(u, send_idx, axis=0)                    # (k, S)
-    recv_u = a2a_or_identity(buf_u, axis_name)
-    halo_u = jnp.take(recv_u.reshape(-1), halo_src, axis=0)  # (R,)
+    ``(B, fout+1)``-lane table: on the dense schedule the scalar rides its
+    own (k, S) buffer (second all_to_all of negligible bytes), dodging the
+    2× tile-padding tax a 129-lane f32 array pays.  On the ragged schedule
+    both lanes ride ONE ring (``halo_exchange_ragged_multi``): the
+    ``(S_d, fout+1)`` concatenation exists only at round size — never the
+    (B, ·) table the split form is dodging — so the two dense dispatches
+    per exchange collapse into one ppermute per live round.  Returns the
+    concatenated ``[local; halo]`` pair
+    ``(full_p (B+R, fout), full_u (B+R,))``."""
+    if comm[0] == "ragged":
+        halo_p, halo_u = halo_exchange_ragged_multi(
+            (p, u), send_idx, halo_src, comm[1], comm[2], axis_name)
+    else:
+        halo_p = halo_exchange(p, send_idx, halo_src, axis_name)
+        buf_u = jnp.take(u, send_idx, axis=0)                    # (k, S)
+        recv_u = a2a_or_identity(buf_u, axis_name)
+        halo_u = jnp.take(recv_u.reshape(-1), halo_src, axis=0)  # (R,)
     return (jnp.concatenate([p, halo_p], axis=0),
             jnp.concatenate([u, halo_u]))
 
@@ -355,17 +426,18 @@ def _unpack_rows(xp, f):
 
 def _packed_aggregate(rows16, scalar, fout, send_idx, halo_src, cell_idx,
                       cell_w, ctail_dst, ctail_src, ctail_w, buckets, b,
-                      axis_name):
+                      axis_name, comm=COMM_A2A):
     """Masked Σ over in-edges of ``(rows16[src], scalar[src])`` — ONE gather
     per edge: the bf16 feature row bit-packs into ``fout/2`` f32 lanes and
     the scalar rides the next lane, so the whole (fout/2 + 1)-wide gathered
     row stays inside one 128-lane tile for fout ≤ 254 (the v5e gather drops
-    3.2× past one tile).  Exchange ships the same packed table: half the
-    ICI bytes of the f32 path.  Used by the bf16 compute path; masked slots
-    contribute exactly 0 either way."""
+    3.2× past one tile).  Exchange ships the same packed table — half the
+    ICI bytes of the f32 path — over whichever transport ``comm`` selects.
+    Used by the bf16 compute path; masked slots contribute exactly 0 either
+    way."""
     half = fout // 2
     table = jnp.concatenate([_pack_rows(rows16), scalar[:, None]], axis=-1)
-    halo = halo_exchange(table, send_idx, halo_src, axis_name)
+    halo = _exchange_table(table, send_idx, halo_src, axis_name, comm)
     full = jnp.concatenate([table, halo], axis=0)     # (B+R, half+1)
 
     def contrib(idx, wv):
@@ -387,7 +459,7 @@ def _use_packed(dtype, fout: int) -> bool:
 
 def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
                            ctail_dst, ctail_src, ctail_w, row_valid, buckets,
-                           axis_name):
+                           axis_name, comm=COMM_A2A):
     b = h.shape[0]
     z = h @ w
     fout = z.shape[-1]
@@ -406,21 +478,22 @@ def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
         p16 = u.astype(jnp.bfloat16)[:, None] * z
         num, den = _packed_aggregate(
             p16, u, fout, send_idx, halo_src, cell_idx, cell_w,
-            ctail_dst, ctail_src, ctail_w, buckets, b, axis_name)
+            ctail_dst, ctail_src, ctail_w, buckets, b, axis_name, comm)
     else:
         # table stays in the compute dtype (bf16 under mixed precision,
         # halving exchange bytes); u itself is f32 for stabilizer exactness
         p = u.astype(z.dtype)[:, None] * z           # (B, fout)
         if _fused_form(fout):
             table = jnp.concatenate([p, u.astype(z.dtype)[:, None]], axis=-1)
-            halo = halo_exchange(table, send_idx, halo_src, axis_name)
+            halo = _exchange_table(table, send_idx, halo_src, axis_name,
+                                   comm)
             full = jnp.concatenate([table, halo], axis=0)   # (B+R, fout+1)
             num, den = _mask_slot_pass(full, fout, cell_idx, cell_w,
                                        ctail_dst, ctail_src, ctail_w,
                                        buckets, b)
         else:
             full_p, full_u = _exchange_rows_scalar(
-                p, u.astype(z.dtype), send_idx, halo_src, axis_name)
+                p, u.astype(z.dtype), send_idx, halo_src, axis_name, comm)
             num, den = _pair_slot_pass(full_p, full_u, fout, cell_idx,
                                        cell_w, ctail_dst, ctail_src,
                                        ctail_w, buckets, b)
@@ -435,10 +508,10 @@ def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
 
 def _gat_layer_sym_fwd(w, a1, a2, h, send_idx, halo_src, cell_idx, cell_w,
                        ctail_dst, ctail_src, ctail_w, row_valid, buckets,
-                       axis_name):
+                       axis_name, comm):
     out, _, _, den, cg = _gat_factored_fwd_core(
         w, a2, h, send_idx, halo_src, cell_idx, cell_w,
-        ctail_dst, ctail_src, ctail_w, row_valid, buckets, axis_name)
+        ctail_dst, ctail_src, ctail_w, row_valid, buckets, axis_name, comm)
     # z and u are NOT stored: at products scale each stored (B, fout) array
     # is 1.25 GB and the fwd+bwd step measured 17.07 GB of HLO temps on a
     # 16 GB chip with them resident; the backward recomputes z = h·w (one
@@ -449,7 +522,7 @@ def _gat_layer_sym_fwd(w, a1, a2, h, send_idx, halo_src, cell_idx, cell_w,
     return out, res
 
 
-def _gat_layer_sym_bwd(buckets, axis_name, res, gbar):
+def _gat_layer_sym_bwd(buckets, axis_name, comm, res, gbar):
     (w, a1, a2, h, cg, den, out, send_idx, halo_src, cell_idx, cell_w,
      ctail_dst, ctail_src, ctail_w) = res
     b = h.shape[0]
@@ -461,22 +534,24 @@ def _gat_layer_sym_bwd(buckets, axis_name, res, gbar):
     dn = gbar / dng[:, None]                         # (B, fout)
     dd = -(gbar * out).sum(axis=-1) / dng            # (B,)
     # transpose of a symmetric pattern = the same aggregation: for src row
-    # j, Σ_i mask_ij·dn_i over j's in-edge slots (aggregators of j)
+    # j, Σ_i mask_ij·dn_i over j's in-edge slots (aggregators of j) — the
+    # backward's [ḡ/D ‖ −(ḡ·out)/D] table rides the SAME transport (comm)
+    # as the forward's, so the ragged ring carries both directions
     if _use_packed(z.dtype, fout):
         dp, du_agg = _packed_aggregate(
             dn.astype(jnp.bfloat16), dd, fout, send_idx, halo_src,
             cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets, b,
-            axis_name)
+            axis_name, comm)
     elif _fused_form(fout):
         table = jnp.concatenate([dn, dd[:, None]], axis=-1)
-        halo = halo_exchange(table, send_idx, halo_src, axis_name)
+        halo = _exchange_table(table, send_idx, halo_src, axis_name, comm)
         full = jnp.concatenate([table, halo], axis=0)
         dp, du_agg = _mask_slot_pass(full, fout, cell_idx, cell_w,
                                      ctail_dst, ctail_src, ctail_w,
                                      buckets, b)
     else:
         full_dn, full_dd = _exchange_rows_scalar(
-            dn, dd, send_idx, halo_src, axis_name)
+            dn, dd, send_idx, halo_src, axis_name, comm)
         dp, du_agg = _pair_slot_pass(full_dn, full_dd, fout, cell_idx,
                                      cell_w, ctail_dst, ctail_src, ctail_w,
                                      buckets, b)
@@ -594,6 +669,12 @@ def gat_forward_local(
                                   # layer, which REQUIRES a symmetric edge
                                   # PATTERN (attention VALUES need not be)
     cell_buckets: tuple | None = None,   # static plan.cell_buckets
+    comm_schedule: str = "a2a",   # static: 'a2a' (dense all_to_all) or
+                                  # 'ragged' (per-round ppermute ring,
+                                  # docs/comm_schedule.md)
+    rr_sizes: tuple | None = None,  # static plan.rr_sizes (ragged)
+    halo_r: int | None = None,      # static plan.r — halo table height
+                                    # (ragged; not derivable from rhalo_dst)
     axis_name: str = AXIS,
     halo_carry=None,              # stale-halo carries (trainer contract slot)
 ):
@@ -621,6 +702,27 @@ def gat_forward_local(
             "run GAT with halo_staleness=0")
     if cell_buckets is None:
         raise ValueError("GAT forward needs the plan's static cell_buckets")
+    if comm_schedule not in ("a2a", "ragged"):
+        raise ValueError(f"unknown comm_schedule {comm_schedule!r} "
+                         "(the trainer resolves 'auto' before the forward)")
+    if comm_schedule == "ragged":
+        # per-round ppermute ring: the attention tables ride the plan's
+        # model-independent per-vertex layout (rsend_idx/rhalo_dst); same
+        # math, f32 bit-identical (tests/test_gat_ragged.py)
+        if not symmetric:
+            raise ValueError(
+                "comm_schedule='ragged' uses the symmetric custom backward "
+                "(the gradient table rides the same ring); asymmetric "
+                "plans run the a2a schedule")
+        if rr_sizes is None or halo_r is None:
+            raise ValueError(
+                "ragged GAT forward needs the plan's static rr_sizes + "
+                "halo table height r (CommPlan.ensure_ragged)")
+        comm = ("ragged", tuple(rr_sizes), int(halo_r))
+        send_idx, halo_src = pa["rsend_idx"], pa["rhalo_dst"]
+    else:
+        comm = COMM_A2A
+        send_idx, halo_src = pa["send_idx"], pa["halo_src"]
     act = get_activation(activation)
     fact = get_activation(final_activation)
     nl = len(params)
@@ -638,9 +740,9 @@ def gat_forward_local(
     for i, p in enumerate(params):
         h = layer(
             p["w"], p["a1"], p["a2"], h,
-            pa["send_idx"], pa["halo_src"],
+            send_idx, halo_src,
             pa["cell_idx"], pa["cell_w"],
             pa["ctail_dst"], pa["ctail_src"], pa["ctail_w"],
-            pa["row_valid"], cell_buckets, axis_name)
+            pa["row_valid"], cell_buckets, axis_name, comm)
         h = fact(h) if i == nl - 1 else act(h)
     return h

@@ -41,14 +41,31 @@ from dataclasses import dataclass, field
 STREAM_CEILING_GBS = 655.0
 
 
+def _exchange_gather_rows(plan, comm_schedule: str = "a2a") -> int:
+    """Per-chip rows the SELECTED transport's exchange machinery gathers
+    per exchange direction.  The dense a2a gathers the whole padded
+    ``(k, S)`` send buffer and then the ``R``-row halo table out of the
+    receive buffer; the ragged ring gathers only its per-round send
+    buffers (``Σ_d S_d`` rows) and SCATTERS receives (``.set`` — no
+    halo-table gather), so charging the dense figure to a ragged run would
+    overstate the stream by exactly the padded rows the ring deletes."""
+    if comm_schedule == "ragged":
+        sizes = (plan.rr_sizes if plan.rr_sizes is not None
+                 else plan.ragged_round_sizes())
+        return int(sum(sizes))
+    return int(plan.k * plan.s + plan.r)
+
+
 def gather_bytes_per_epoch(plan, fin: int, widths,
-                           itemsize: int = 4) -> int:
+                           itemsize: int = 4,
+                           comm_schedule: str = "a2a") -> int:
     """Bytes the epoch's row gathers move (fwd + symmetric bwd), from the
     plan's padded layout — the numerator of the roofline figure.
 
     Counts the gather streams only (ELL slots, hub tails, halo-src edges,
-    send-buffer and halo-buffer gathers), at the aggregation width of each
-    layer (``models/gcn.py::exchange_widths`` — the trainer's project-first
+    and the selected transport's exchange gathers —
+    ``_exchange_gather_rows``), at the aggregation width of each layer
+    (``models/gcn.py::exchange_widths`` — the trainer's project-first
     rule).  Accumulate-side traffic (~30% more, BASELINE.md utilization
     accounting) is deliberately excluded: the metric is 'how fast are the
     gathers running', matching the measured 655 GB/s stream ceiling
@@ -58,7 +75,7 @@ def gather_bytes_per_epoch(plan, fin: int, widths,
     ell_slots = sum(nb * wb for nb, wb in plan.ell_buckets)
     rows = ell_slots + plan.tl          # local ELL + tail
     rows += plan.eh                     # halo-src edge gathers
-    rows += plan.k * plan.s + plan.r    # send-buffer + halo-table gathers
+    rows += _exchange_gather_rows(plan, comm_schedule)
     return int(2 * rows * itemsize * sum(exchange_widths(fin, widths)))
 
 
@@ -94,7 +111,8 @@ class StepCostModel:
 
 def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
               wire_itemsize: int | None = None,
-              comm_schedule: str = "a2a") -> StepCostModel:
+              comm_schedule: str = "a2a",
+              model: str = "gcn") -> StepCostModel:
     """Build the cost model for one (plan, layer-stack) pair.
 
     ``compute_dtype='bfloat16'`` halves the gather/wire itemsize (the
@@ -102,14 +120,34 @@ def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
     (the ``--halo-dtype bfloat16`` wire-only lever).  ``comm_schedule``
     selects the wire-byte model: the plan's TRUE volume (Σ(λ−1)) is
     schedule-independent, but the shipped bytes are the schedule's padded
-    buffer — ``plan.wire_rows_per_exchange(schedule)``."""
-    from ..models.gcn import exchange_widths
+    buffer — ``plan.wire_rows_per_exchange(schedule)``.
 
-    itemsize = 2 if compute_dtype == "bfloat16" else 4
-    wire_b = itemsize if wire_itemsize is None else wire_itemsize
-    fs = exchange_widths(fin, list(widths))
+    ``model='gat'`` switches every per-layer width to the GAT exchange's
+    REAL table lanes (``models.gat.gat_exchange_lane_widths``: fused
+    ``fout+1``, packed-bf16 ``fout/2+1``, split pair ``fout+1`` across its
+    buffers — all in f32-lane equivalents, so the itemsize stays 4 and
+    narrow dtypes are encoded in the lane count), the SpMM term to the
+    combined-edge num/den slot passes (one fused gather-accumulate per
+    combined slot and tail edge, at the table width), and the gather-stream
+    model to the combined layout (slot + tail table gathers plus the
+    exchange's send/halo gathers).  Wire accounting is therefore the same
+    figure CommStats' lane-weighted gauges report — the parity the
+    reconciliation smokes pin (``wire_itemsize`` is ignored for GAT; its
+    wire levers are the table forms themselves)."""
+    if model == "gat":
+        from ..models.gat import gat_exchange_lane_widths
+        plan.ensure_cell()
+        fs = gat_exchange_lane_widths(list(widths), compute_dtype)
+        itemsize = wire_b = 4           # lanes are f32 equivalents
+        # combined-edge work per layer: bucketed slots + hub tail
+        nnz = sum(nb * wb for nb, wb in plan.cell_buckets) + int(plan.ctl)
+    else:
+        from ..models.gcn import exchange_widths
+        itemsize = 2 if compute_dtype == "bfloat16" else 4
+        wire_b = itemsize if wire_itemsize is None else wire_itemsize
+        fs = exchange_widths(fin, list(widths))
+        nnz = int(plan.nnz.max()) if plan.nnz.size else 0
     dims = list(zip([fin] + list(widths)[:-1], widths))
-    nnz = int(plan.nnz.max()) if plan.nnz.size else 0
     b = plan.b
     send_rows = int(plan.predicted_send_volume.sum())
     wire_rows = int(plan.wire_rows_per_exchange(comm_schedule))
@@ -130,6 +168,17 @@ def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
         len(per_layer), 1)
     true_step = int(2 * sum(pl["halo_bytes_true"] for pl in per_layer))
     wire_step = int(2 * sum(pl["halo_bytes_wire"] for pl in per_layer))
+    if model == "gat":
+        # fwd + bwd table-gather streams: per layer, one gathered row per
+        # combined slot/tail edge plus the SELECTED transport's exchange
+        # gathers (dense: send buffer + halo table; ragged: per-round send
+        # buffers only — receives scatter), at that layer's table width
+        rows = nnz + _exchange_gather_rows(plan, comm_schedule)
+        gather_b = int(2 * rows * 4 * sum(fs))
+    else:
+        gather_b = int(gather_bytes_per_epoch(plan, fin, widths,
+                                              itemsize=itemsize,
+                                              comm_schedule=comm_schedule))
     return StepCostModel(
         nlayers=len(widths),
         widths=[int(w) for w in fs],
@@ -137,8 +186,7 @@ def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
         dense_flops=int(dense_f),
         # symmetric bwd = one more SpMM pass; dense bwd = dX + dW ≈ 2× fwd
         step_flops=int(2 * spmm_f + 3 * dense_f),
-        gather_bytes=int(gather_bytes_per_epoch(plan, fin, widths,
-                                                itemsize=itemsize)),
+        gather_bytes=gather_b,
         halo_send_rows=send_rows,
         halo_bytes_per_exchange=int(halo_per_ex),
         halo_bytes_per_step=true_step,

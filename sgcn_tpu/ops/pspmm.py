@@ -154,20 +154,68 @@ def ppermute_or_identity(buf, axis_name: str, d: int):
                         perm=[(p, (p + d) % k) for p in range(k)])
 
 
+def halo_exchange_ragged_multi(parts, rsend_idx, rhalo_dst, rr_sizes, r: int,
+                               axis_name: str = AXIS, halo_dtype=None):
+    """Ragged ppermute-ring exchange of SEVERAL row tables in ONE ring.
+
+    The table-width-agnostic core of the ragged schedule: ``parts`` is a
+    tuple of per-vertex arrays — each ``(B, d_i)`` (or ``(B,)`` for a scalar
+    lane) — and every live round ships ONE concatenated
+    ``(S_d, Σ d_i)``-lane buffer, so a feature table and its companion
+    scalar (the GAT split path's ``(p, u)`` pair, two dense dispatches per
+    exchange on the a2a schedule) cost a single ppermute per round.  The
+    per-vertex send/receive layout (``rsend_idx``/``rhalo_dst``,
+    ``CommPlan.ensure_ragged``) is model-independent: round ``d`` carries
+    chip p → (p+d)%k in a buffer statically sized to that round's own max
+    send count (``rr_sizes[d-1]``), rounds with S_d = 0 vanish at trace
+    time, and received rows scatter (``.set``, each slot written exactly
+    once) into their contiguous per-owner halo slice — so every part's halo
+    table holds bit-identical rows to the dense exchange's, whatever its
+    lane count.  Padding receive slots target row ``r`` and are dropped;
+    padding halo rows therefore hold zeros (the dense exchange leaves
+    garbage there — both are only ever referenced by weight-0/masked
+    slots).  ``halo_dtype`` narrows the whole concatenated wire buffer
+    only; mixed part dtypes ride the promoted dtype and are cast back per
+    part on arrival.
+
+    Returns a tuple of per-part halo tables, shaped ``(r,) + part.shape[1:]``.
+    """
+    lanes = [p.shape[1] if p.ndim == 2 else 1 for p in parts]
+    halos = [jnp.zeros((r,) + p.shape[1:], p.dtype) for p in parts]
+    off = 0
+    for d, sd in enumerate(rr_sizes, start=1):
+        if sd == 0:
+            continue
+        idx = rsend_idx[off: off + sd]
+        bufs = [jnp.take(p, idx, axis=0) for p in parts]
+        if len(parts) == 1:
+            buf = bufs[0]
+        else:
+            buf = jnp.concatenate(
+                [b.reshape(sd, ln) for b, ln in zip(bufs, lanes)], axis=-1)
+        if halo_dtype is not None:
+            buf = buf.astype(halo_dtype)
+        recv = ppermute_or_identity(buf, axis_name, d)
+        dst = rhalo_dst[off: off + sd]
+        col = 0
+        for i, (p, ln) in enumerate(zip(parts, lanes)):
+            seg = recv if len(parts) == 1 else recv[:, col: col + ln]
+            seg = seg.reshape((sd,) + p.shape[1:]).astype(p.dtype)
+            halos[i] = halos[i].at[dst].set(seg, mode="drop")
+            col += ln
+        off += sd
+    return tuple(halos)
+
+
 def halo_exchange_ragged(h, rsend_idx, rhalo_dst, rr_sizes, r: int,
                          axis_name: str = AXIS, halo_dtype=None):
     """Ragged ppermute-ring halo exchange; returns the (R, f) halo block.
 
     The plan-driven replacement for ``halo_exchange``'s dense all_to_all:
-    k−1 rounds of ``lax.ppermute`` where round ``d`` carries chip
-    p → (p+d)%k in a buffer statically sized to that round's max send count
-    (``rr_sizes[d-1]``, see ``CommPlan.ensure_ragged``) — per-round pad, not
-    global pad, so the wire carries Σ_d k·S_d rows instead of k²·S.  Rounds
-    with S_d = 0 vanish at trace time.  Received rows scatter into their
-    contiguous per-owner halo slice (``rhalo_dst``; padding slots target row
-    ``r`` and are dropped), so the table fills round by round as rows
-    arrive.  ``halo_dtype`` narrows the wire only, exactly like the dense
-    exchange's lever.
+    the single-table form of ``halo_exchange_ragged_multi`` — per-round pad,
+    not global pad, so the wire carries Σ_d k·S_d rows instead of k²·S.
+    ``halo_dtype`` narrows the wire only, exactly like the dense exchange's
+    lever.
 
     Args:
       h: (B, f) local feature rows.
@@ -176,18 +224,8 @@ def halo_exchange_ragged(h, rsend_idx, rhalo_dst, rr_sizes, r: int,
       rr_sizes: static per-round sizes, length k−1.
       r: halo table height.
     """
-    halo = jnp.zeros((r, h.shape[-1]), h.dtype)
-    off = 0
-    for d, sd in enumerate(rr_sizes, start=1):
-        if sd == 0:
-            continue
-        buf = jnp.take(h, rsend_idx[off: off + sd], axis=0)   # (S_d, f)
-        if halo_dtype is not None:
-            buf = buf.astype(halo_dtype)
-        recv = ppermute_or_identity(buf, axis_name, d)
-        halo = halo.at[rhalo_dst[off: off + sd]].set(
-            recv.astype(h.dtype), mode="drop")
-        off += sd
+    (halo,) = halo_exchange_ragged_multi(
+        (h,), rsend_idx, rhalo_dst, rr_sizes, r, axis_name, halo_dtype)
     return halo
 
 

@@ -496,22 +496,38 @@ class CommPlan:
 
 def resolve_comm_schedule(schedule: str | None, plans, model: str,
                           halo_staleness: int = 0,
-                          fin: int | None = None, widths=None) -> str:
+                          fin: int | None = None, widths=None,
+                          compute_dtype: str | None = None) -> str:
     """Resolve a ``comm_schedule`` knob to a concrete transport — THE one
     selection rule shared by both trainers (a second copy would drift).
 
     ``None`` reads ``$SGCN_COMM_SCHEDULE`` (default ``'a2a'``).  ``'auto'``
     is a PREFERENCE: it picks ``'ragged'`` only when every plan supports it
-    (GCN, symmetric, exact mode, full square counts or a pre-built ragged
-    layout, k > 1), the aggregate dense padding efficiency
-    Σ send_counts / Σ k²·S falls below ``RAGGED_AUTO_EFFICIENCY``, AND the
-    choice does not forfeit the Pallas VMEM aggregator — the ragged fold is
-    pinned to the ELL path, so in the VMEM regime (``use_pallas_spmm``) the
-    kernel's measured win outweighs the wire padding and a2a stays.
-    Everything else resolves to ``'a2a'`` silently.  An explicit
-    ``'ragged'`` is a CONTRACT — callers validate it loudly themselves.
+    (symmetric, exact mode, full square counts or a pre-built ragged
+    layout, k > 1), the aggregate dense padding efficiency falls below
+    ``RAGGED_AUTO_EFFICIENCY``, AND the choice does not forfeit the Pallas
+    VMEM aggregator (GCN only — the ragged fold is pinned to the ELL path,
+    so in the VMEM regime (``use_pallas_spmm``) the kernel's measured win
+    outweighs the wire padding and a2a stays; GAT has no VMEM aggregator
+    to forfeit).  Everything else resolves to ``'a2a'`` silently.  An
+    explicit ``'ragged'`` is a CONTRACT — callers validate it loudly
+    themselves.
+
+    The scored quantity IS the wire-byte efficiency of the model's real
+    exchange tables: every exchange of a plan ships the same row set at
+    every lane width (GCN's ``exchange_widths`` rows, GAT's
+    ``gat_exchange_lane_widths`` tables — fused ``fout+1`` vs packed
+    ``fout/2+1``), so the per-layer lane weights multiply true and wire
+    bytes uniformly and the byte ratio REDUCES EXACTLY to the row ratio
+    computed below — no separate lane arithmetic is needed here, and the
+    selection is provably identical for every table form.  The lane widths
+    live where bytes genuinely differ: the attribution/CommStats
+    ``halo_bytes_true``/``halo_bytes_wire`` gauges.  ``compute_dtype`` is
+    accepted for signature stability with those byte models; it cannot
+    change the ratio.
     """
     import os
+    del compute_dtype       # lane weights cancel in the ratio (see above)
     if schedule is None:
         schedule = os.environ.get("SGCN_COMM_SCHEDULE", "a2a")
     if schedule not in ("a2a", "ragged", "auto"):
@@ -520,7 +536,7 @@ def resolve_comm_schedule(schedule: str | None, plans, model: str,
             f"{schedule!r}")
     if schedule != "auto":
         return schedule
-    if model != "gcn" or halo_staleness:
+    if model not in ("gcn", "gat") or halo_staleness:
         return "a2a"
     true = wire = 0
     for p in plans:
@@ -533,7 +549,7 @@ def resolve_comm_schedule(schedule: str | None, plans, model: str,
         wire += p.wire_rows_per_exchange("a2a")
     if not wire or true / wire >= RAGGED_AUTO_EFFICIENCY:
         return "a2a"
-    if fin is not None and widths is not None:
+    if model == "gcn" and fin is not None and widths is not None:
         from ..ops.pallas_spmm import use_pallas_spmm   # deferred: jax
         if use_pallas_spmm(plans[0], fin, widths):
             return "a2a"

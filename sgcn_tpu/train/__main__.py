@@ -77,7 +77,8 @@ def main() -> None:
                         "dense globally-padded all_to_all (default); "
                         "ragged = per-round-sized ppermute ring (same "
                         "math, bit-identical f32 losses, fewer wire bytes "
-                        "on skewed partitions; GCN + symmetric adjacency); "
+                        "on skewed partitions; symmetric adjacency — GCN "
+                        "ships feature rows, GAT its attention tables); "
                         "auto = ragged when the plan's padding efficiency "
                         "drops below 0.5.  Default: $SGCN_COMM_SCHEDULE, "
                         "else a2a")
@@ -150,13 +151,11 @@ def main() -> None:
         raise SystemExit(
             "--halo-delta/--sync-every configure the stale pipelined "
             "exchange; add --halo-staleness 1")
-    if args.comm_schedule == "ragged" and (args.model != "gcn"
-                                           or args.halo_staleness
+    if args.comm_schedule == "ragged" and (args.halo_staleness
                                            or args.experiment == "accuracy"):
         raise SystemExit(
-            "--comm-schedule ragged drives the full-batch/mini-batch GCN "
-            "halo exchange only (GAT ships attention tables over the dense "
-            "a2a; composition with --halo-staleness 1 is deferred; the "
+            "--comm-schedule ragged is the exact-mode transport "
+            "(composition with --halo-staleness 1 is deferred; the "
             "accuracy-parity harness is defined for the default transport) "
             "— drop the conflicting flag or use --comm-schedule auto")
 

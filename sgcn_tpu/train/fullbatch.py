@@ -250,9 +250,14 @@ class FullBatchTrainer:
         bit-identical losses, strictly fewer wire bytes whenever
         ``send_counts`` is skewed; ``'auto'`` picks ragged when the plan's
         dense padding efficiency falls below ``RAGGED_AUTO_EFFICIENCY``
-        (``parallel/plan.py``).  ``None`` reads ``$SGCN_COMM_SCHEDULE``
-        (default ``'a2a'``).  GCN + symmetric Â only; composition with
-        ``halo_staleness=1`` is deferred (clean error)."""
+        (``parallel/plan.py`` — the wire-byte ratio, which reduces to the
+        row ratio for every table form).  ``None`` reads
+        ``$SGCN_COMM_SCHEDULE`` (default ``'a2a'``).  Model-agnostic: GCN
+        rides the ring with feature rows, GAT with its per-layer attention
+        tables (fused, packed-bf16 and split forms — the split pair's two
+        dense dispatches collapse into one two-lane ring).  Symmetric edge
+        patterns only; composition with ``halo_staleness=1`` is deferred
+        (clean error)."""
         if halo_dtype is not None and model != "gcn":
             raise ValueError(
                 "halo_dtype is a GCN-trainer lever; for GAT use "
@@ -295,16 +300,11 @@ class FullBatchTrainer:
         from ..parallel.plan import resolve_comm_schedule
         comm_schedule = resolve_comm_schedule(
             comm_schedule, [plan], model, halo_staleness,
-            fin=fin, widths=list(widths))
+            fin=fin, widths=list(widths), compute_dtype=compute_dtype)
         if comm_schedule == "ragged":
-            if model != "gcn":
-                raise ValueError(
-                    "comm_schedule='ragged' drives the GCN halo exchange; "
-                    "the GAT exchange ships per-layer attention tables over "
-                    "the dense a2a — drop the flag or use 'auto'")
             if not plan.symmetric:
                 raise ValueError(
-                    "comm_schedule='ragged' uses the symmetric-Â custom "
+                    "comm_schedule='ragged' uses the symmetric custom "
                     "backward (the gradient rides the same ppermute ring); "
                     "this plan is asymmetric — run the a2a schedule")
             if halo_staleness:
@@ -367,6 +367,17 @@ class FullBatchTrainer:
                     "pallas_tb": plan.pallas_tb,
                     "pallas_emulate": jax.default_backend() != "tpu",
                 }
+        if model == "gat" and comm_schedule == "ragged":
+            # the attention tables ride the plan's model-independent
+            # per-vertex ring layout (rsend_idx/rhalo_dst); the combined
+            # bucketed slot passes are schedule-blind, so only the shipped
+            # exchange arrays and the static ring spec change
+            from ..models.gat import GAT_PLAN_FIELDS_RAGGED
+            self.plan_fields = GAT_PLAN_FIELDS_RAGGED
+            self._fwd_static = dict(self._fwd_static,
+                                    comm_schedule="ragged",
+                                    rr_sizes=plan.rr_sizes,
+                                    halo_r=plan.r)
         if model == "gat":
             # pre-flight the measured single-chip capacity edge: a clear
             # error beats a compile OOM or a dead TPU worker — BOTH were
@@ -401,7 +412,25 @@ class FullBatchTrainer:
             for f in ("cell_w", "ctail_w"):
                 arrays[f] = (arrays[f] != 0).astype(np.int8)
         self.pa = shard_stacked(self.mesh, arrays)
-        self.stats = CommStats.from_plan(plan, schedule=comm_schedule)
+        # per-exchange wire lane widths (f32-lane equivalents) — the real
+        # table widths each model ships, so the CommStats byte gauges
+        # (halo_bytes_true/halo_bytes_wire) reconcile EXACTLY with the obs
+        # roofline's attribution (docs/observability.md): GCN ships feature
+        # rows at the project-first widths, GAT its attention tables (fused
+        # fout+1 / packed fout/2+1 / split pair)
+        if model == "gat":
+            from ..models.gat import gat_exchange_lane_widths
+            lane_widths = tuple(gat_exchange_lane_widths(
+                self.widths, compute_dtype))
+            wire_itemsize = 4       # lanes already encode the narrow dtype
+        else:
+            from ..models.gcn import exchange_widths
+            lane_widths = tuple(exchange_widths(fin, self.widths))
+            wire_itemsize = 2 if (halo_dtype == "bfloat16" or halo_delta
+                                  or compute_dtype == "bfloat16") else 4
+        self.stats = CommStats.from_plan(plan, schedule=comm_schedule,
+                                         lane_widths=lane_widths,
+                                         wire_itemsize=wire_itemsize)
         self._step = self._build_step()
         self._eval = self._build_eval()
         self._multi = {}        # epochs -> compiled on-device epoch loop
@@ -835,17 +864,21 @@ class FullBatchTrainer:
 
         roofline = None
         # same honesty gate as bench.py: the gather model describes the
-        # bucketed-ELL GCN aggregator — for GAT (attention-table exchange)
-        # or the Pallas VMEM kernel it would describe a program that didn't
-        # run, so omit it rather than mislead
-        if self.model == "gcn" and "pallas_tb" not in self._fwd_static:
+        # bucketed slot-pass aggregators (GCN ELL, GAT combined-edge) — for
+        # the Pallas VMEM kernel it would describe a program that didn't
+        # run, so omit it rather than mislead.  GAT attributes against its
+        # own table-form-aware model (attribution.step_cost(model='gat')),
+        # which is what makes the wire gauges reconcile with CommStats'.
+        if "pallas_tb" not in self._fwd_static:
             if self._cost is None:
                 self._cost = step_cost(
                     self.plan, self.fin, self.widths,
                     compute_dtype=self.compute_dtype,
-                    wire_itemsize=2 if (self.halo_dtype == "bfloat16"
-                                        or self.halo_delta) else None,
-                    comm_schedule=self.comm_schedule)
+                    wire_itemsize=2 if (self.model == "gcn"
+                                        and (self.halo_dtype == "bfloat16"
+                                             or self.halo_delta)) else None,
+                    comm_schedule=self.comm_schedule,
+                    model=self.model)
             ex_step = 2 * self.nlayers      # this step's exchanges
             exposed_step = 0 if (drift is not None
                                  and not drift.get("sync_step")) else ex_step

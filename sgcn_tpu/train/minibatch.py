@@ -135,7 +135,8 @@ class MiniBatchTrainer:
         # round sizes to the elementwise max
         from ..parallel.plan import resolve_comm_schedule
         comm_schedule = resolve_comm_schedule(
-            comm_schedule, self.plans, model, fin=fin, widths=list(widths))
+            comm_schedule, self.plans, model, fin=fin, widths=list(widths),
+            compute_dtype=compute_dtype)
         if comm_schedule == "ragged":
             # EVERY plan needs the layout (the fused sweep stacks the ragged
             # arrays across batches), padded to the shared round envelope;
@@ -228,7 +229,11 @@ class MiniBatchTrainer:
                                  _plan_arrays(plan, self.inner.plan_fields)),
                 data=TrainData(**shard_stacked(self.mesh, vars(data))),
                 stats=CommStats.from_plan(
-                    plan, schedule=self.inner.comm_schedule),
+                    plan, schedule=self.inner.comm_schedule,
+                    # same per-layer wire lane widths as the inner trainer's
+                    # counters, so per-batch byte gauges stay comparable
+                    lane_widths=self.inner.stats.lane_widths,
+                    wire_itemsize=self.inner.stats.wire_itemsize),
             ))
         return out
 

@@ -42,9 +42,22 @@ class CommStats:
     #                                        over the chips in view)
     padding_efficiency: float = 1.0        # true / wire of the SELECTED
     #                                        schedule
+    # Per-layer wire LANE widths (f32-lane equivalents) of one step's
+    # exchange sequence — the real table widths the model ships: GCN's
+    # project-first ``exchange_widths``, GAT's attention-table lanes (fused
+    # fout+1, packed fout/2+1, split pair = fout+1 across its buffers;
+    # ``models.gat.gat_exchange_lane_widths``).  With them set, ``report()``
+    # carries byte gauges (halo_bytes_true/halo_bytes_wire per step) that
+    # must reconcile EXACTLY with the obs roofline's attribution
+    # (tests/test_metrics_cli.py, tests/test_gat_ragged.py).  Empty = rows
+    # only (pre-PR-5 reports).
+    lane_widths: tuple = ()
+    wire_itemsize: int = 4                 # bytes per f32-equivalent lane
 
     @classmethod
-    def from_plan(cls, plan, schedule: str = "a2a") -> "CommStats":
+    def from_plan(cls, plan, schedule: str = "a2a",
+                  lane_widths: tuple = (),
+                  wire_itemsize: int = 4) -> "CommStats":
         off = plan.offwire_send_counts()
         send_vol = plan.predicted_send_volume.astype(np.int64)
         send_msg = plan.predicted_message_count.astype(np.int64)
@@ -74,6 +87,8 @@ class CommStats:
             schedule=schedule,
             wire_rows_per_exchange=wire,
             padding_efficiency=(true / wire if wire else 1.0),
+            lane_widths=tuple(int(w) for w in lane_widths),
+            wire_itemsize=int(wire_itemsize),
         )
 
     def count_step(self, nlayers: int, hidden: bool = False) -> None:
@@ -137,6 +152,16 @@ class CommStats:
             wire_rows_total=self.wire_rows_per_exchange * self.exchanges,
             padding_efficiency=self.padding_efficiency,
         )
+        if self.lane_widths:
+            # lane-weighted byte gauges: one fwd + one bwd exchange per
+            # layer per step, each at that layer's true wire width — the
+            # CommStats side of the attribution reconciliation contract
+            lane_b = 2 * sum(self.lane_widths) * self.wire_itemsize
+            rep.update(
+                halo_bytes_true_per_step=per_ex * lane_b,
+                halo_bytes_wire_per_step=self.wire_rows_per_exchange
+                * lane_b,
+            )
         return rep
 
     @staticmethod

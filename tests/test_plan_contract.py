@@ -14,7 +14,7 @@ import dataclasses
 import numpy as np
 
 from sgcn_tpu.io.datasets import er_graph
-from sgcn_tpu.models.gat import GAT_PLAN_FIELDS
+from sgcn_tpu.models.gat import GAT_PLAN_FIELDS, GAT_PLAN_FIELDS_RAGGED
 from sgcn_tpu.models.gcn import (GCN_PLAN_FIELDS_GEN, GCN_PLAN_FIELDS_RAGGED,
                                  GCN_PLAN_FIELDS_SYM)
 from sgcn_tpu.ops.pallas_spmm import PALLAS_PLAN_FIELDS
@@ -30,6 +30,7 @@ CONSUMER_TUPLES = {
     "_GLOBAL_ARRAY_FIELDS": _GLOBAL_ARRAY_FIELDS,
     "PALLAS_PLAN_FIELDS": PALLAS_PLAN_FIELDS,
     "GAT_PLAN_FIELDS": GAT_PLAN_FIELDS,
+    "GAT_PLAN_FIELDS_RAGGED": GAT_PLAN_FIELDS_RAGGED,
     "GCN_PLAN_FIELDS_SYM": GCN_PLAN_FIELDS_SYM,
     "GCN_PLAN_FIELDS_GEN": GCN_PLAN_FIELDS_GEN,
     "GCN_PLAN_FIELDS_RAGGED": GCN_PLAN_FIELDS_RAGGED,
@@ -89,6 +90,7 @@ def test_shipped_field_tuples_are_sliceable():
     plan = _full_plan()
     proxy = shard_proxy_plan(plan, chip=1)      # raises on any drift
     for tup_name in ("PALLAS_PLAN_FIELDS", "GAT_PLAN_FIELDS",
+                     "GAT_PLAN_FIELDS_RAGGED",
                      "GCN_PLAN_FIELDS_SYM", "GCN_PLAN_FIELDS_GEN",
                      "GCN_PLAN_FIELDS_RAGGED"):
         for f in CONSUMER_TUPLES[tup_name]:
@@ -114,3 +116,7 @@ def test_ragged_fields_covered_on_day_one():
     assert isinstance(plan.rr_sizes, tuple)
     assert isinstance(plan.rr_edge_sizes, tuple)
     assert set(GCN_PLAN_FIELDS_RAGGED) <= set(PER_CHIP_ARRAY_FIELDS)
+    # the PR-5 GAT-ragged tuple rides the SAME ensure_ragged arrays — no
+    # new dataclass fields, but the consumer tuple is covered day one
+    assert set(GAT_PLAN_FIELDS_RAGGED) <= set(PER_CHIP_ARRAY_FIELDS)
+    assert {"rsend_idx", "rhalo_dst"} <= set(GAT_PLAN_FIELDS_RAGGED)

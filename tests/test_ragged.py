@@ -309,14 +309,16 @@ def test_ensure_ragged_needs_full_plan(skewplan):
 
 def test_gating(asymplan, cora):
     """Invalid combinations fail loudly at construction: stale composition
-    (deferred), asymmetric plans, GAT, unknown values."""
+    (deferred), asymmetric plans, unknown values.  GAT + ragged is a
+    SUPPORTED contract since the multi-lane ring (tests/test_gat_ragged.py
+    owns its parity coverage)."""
     plan, *_ = cora
     with pytest.raises(ValueError, match="does not compose with"):
         FullBatchTrainer(plan, fin=8, widths=[8, 7], halo_staleness=1,
                          comm_schedule="ragged")
-    with pytest.raises(ValueError, match="attention tables"):
-        FullBatchTrainer(plan, fin=8, widths=[8, 7], model="gat",
-                         comm_schedule="ragged")
+    tr_gat = FullBatchTrainer(plan, fin=8, widths=[8, 7], model="gat",
+                              comm_schedule="ragged")
+    assert tr_gat.comm_schedule == "ragged"
     with pytest.raises(ValueError, match="a2a"):
         FullBatchTrainer(plan, fin=8, widths=[8, 7], comm_schedule="bogus")
     # stale + auto silently keeps the a2a wire (auto is a preference)
