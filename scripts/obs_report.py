@@ -128,6 +128,20 @@ def render(path: str, max_steps: int = 12) -> str:
             lines.append("  staleness age: last "
                          + str(drifts[-1]["staleness_age"]) + ", max "
                          + str(max(d["staleness_age"] for d in drifts)))
+            ages = [d["round_age"] for d in drifts
+                    if d.get("round_age") is not None]
+            if ages:
+                # composed stale × ragged mode: per-round consumed-buffer
+                # age ("-" = empty round, ships nothing)
+                live = sum(1 for x in ages[-1] if x is not None)
+                max_age = max((x for ra in ages for x in ra
+                               if x is not None), default=0)
+                lines.append(
+                    "  round ages (ragged ring): last ["
+                    + " ".join("-" if x is None else str(x)
+                               for x in ages[-1])
+                    + f"]  ({live}/{len(ages[-1])} live rounds, "
+                    + f"max age {max_age})")
             for layer in range(nl):
                 dr = [d["halo_drift_rms"][layer] for d in drifts]
                 rel = [d["halo_drift_rel"][layer] for d in drifts]

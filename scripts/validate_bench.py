@@ -34,6 +34,13 @@ What counts as a violation:
     config must win STRICTLY — the satellite's acceptance figure, asserted
     on wire rows, never epoch speed), or be ``null`` WITH a matching
     ``*_degraded`` marker;
+  * **composed-mode accounting** (PR-6): a ``ragged_stale_ab_8dev`` block
+    must carry all three arms (a2a+stale, ragged+exact, ragged+stale) with
+    positive timings and an exposed-comm accounting in which the composed
+    arm is ≤ both single levers on the exposed fraction and STRICTLY below
+    both on exposed wire rows per step, plus the honest-measurement note
+    (CPU-mesh epoch speed is never the asserted figure), or be ``null``
+    with a degradation marker;
   * **the pow2-k RB constraint** (``products_ksweep.json``): ``hp_rb``
     entries at non-power-of-two k, or k < 32.  The PR-2 review incident:
     ``partition_hypergraph_rb`` recurses on k/2 and the auto-select
@@ -109,6 +116,77 @@ def check_bench_record(rec: dict) -> list[str]:
             errs += check_ragged_ab(parsed)
         if "gat_ragged_ab_8dev" in parsed:
             errs += check_ragged_ab(parsed, prefix="gat_ragged_ab")
+        if "ragged_stale_ab_8dev" in parsed:
+            errs += check_ragged_stale_ab(parsed)
+    return errs
+
+
+def check_ragged_stale_ab(parsed: dict) -> list[str]:
+    """The composed-mode three-way A/B contract (PR-6): the
+    ``ragged_stale_ab_8dev`` block must carry all three arms (a2a+stale,
+    ragged+exact, ragged+stale) with positive paired-differential timings
+    and a consistent exposed-comm accounting in which the composed arm's
+    exposed fraction is <= both single levers and its exposed wire rows
+    per step are STRICTLY below both — the acceptance figure of the
+    composition (never CPU-mesh epoch speed; the block must say so in its
+    honest-measurement ``note``).  ``null`` needs a degradation marker."""
+    errs = []
+    block = parsed["ragged_stale_ab_8dev"]
+    if block is None:
+        if not isinstance(parsed.get("ragged_stale_ab_degraded"), str):
+            errs.append("ragged_stale_ab_8dev null without a "
+                        "ragged_stale_ab_degraded marker "
+                        "(graceful-degradation contract)")
+        return errs
+    if not isinstance(block, dict):
+        return [f"ragged_stale_ab_8dev is {type(block).__name__}, expected "
+                "dict or null"]
+    arms = block.get("arms")
+    if not isinstance(arms, dict):
+        return ["ragged_stale_ab_8dev carries no arms dict"]
+    required = ("a2a_stale", "ragged_exact", "ragged_stale")
+    missing = [a for a in required if not isinstance(arms.get(a), dict)]
+    if missing:
+        return [f"ragged_stale_ab_8dev missing arm(s) {missing}"]
+    for nm in required:
+        e = arms[nm]
+        if not (_is_num(e.get("epoch_s")) and e["epoch_s"] > 0):
+            errs.append(f"ragged_stale_ab_8dev.arms.{nm}.epoch_s="
+                        f"{e.get('epoch_s')!r}")
+        frac = e.get("exposed_comm_frac")
+        if not (_is_num(frac) and 0 <= frac <= 1):
+            errs.append(f"ragged_stale_ab_8dev.arms.{nm}: "
+                        f"exposed_comm_frac={frac!r} outside [0, 1]")
+        for key in ("wire_rows_per_exchange", "exposed_wire_rows_per_step"):
+            if not (_is_num(e.get(key)) and e[key] >= 0):
+                errs.append(f"ragged_stale_ab_8dev.arms.{nm}.{key}="
+                            f"{e.get(key)!r}")
+    if errs:
+        return errs
+    comp, a2s, rex = (arms["ragged_stale"], arms["a2a_stale"],
+                      arms["ragged_exact"])
+    if not (comp["exposed_comm_frac"] <= a2s["exposed_comm_frac"]
+            and comp["exposed_comm_frac"] <= rex["exposed_comm_frac"]):
+        errs.append("ragged_stale_ab_8dev: composed exposed_comm_frac "
+                    f"{comp['exposed_comm_frac']} exceeds a single lever's "
+                    "— the composition's acceptance inequality")
+    if not (comp["exposed_wire_rows_per_step"]
+            < a2s["exposed_wire_rows_per_step"]
+            and comp["exposed_wire_rows_per_step"]
+            < rex["exposed_wire_rows_per_step"]):
+        errs.append("ragged_stale_ab_8dev: composed exposed wire rows "
+                    f"{comp['exposed_wire_rows_per_step']} not STRICTLY "
+                    "below both single levers "
+                    f"({a2s['exposed_wire_rows_per_step']}, "
+                    f"{rex['exposed_wire_rows_per_step']})")
+    cp = block.get("clean_pairs")
+    if not (_is_num(cp) and cp >= 1):
+        errs.append(f"ragged_stale_ab_8dev: clean_pairs={cp!r}")
+    note = block.get("note")
+    if not (isinstance(note, str) and "exposed" in note):
+        errs.append("ragged_stale_ab_8dev: missing the honest-measurement "
+                    "note naming exposed-comm accounting as the asserted "
+                    "figure (CPU-mesh epoch speed is not the claim)")
     return errs
 
 

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.pspmm import (pspmm_ell_sym, pspmm_overlap, pspmm_ragged_sym,
-                         pspmm_stale)
+                         pspmm_stale, pspmm_stale_ragged)
 from ..parallel.mesh import AXIS
 from .activations import get_activation
 
@@ -185,10 +185,13 @@ def gcn_forward_local(
 def gcn_forward_local_stale(
     params,
     h,                      # (B, f_in) local feature rows
-    pa,                     # plan arrays dict (GCN_PLAN_FIELDS_SYM)
-    halos,                  # per-layer (R, f_ℓ) halo carries (step t−1)
-    ghalos,                 # per-layer (R, f_ℓ) gradient-halo carries
-    bases,                  # per-layer (k, S, f_ℓ) delta baselines (or dummies)
+    pa,                     # plan arrays dict (GCN_PLAN_FIELDS_SYM, or
+    #                         STALE_PLAN_FIELDS_RAGGED under 'ragged')
+    halos,                  # per-layer halo carries (step t−1): (R, f_ℓ)
+    #                         dense, (ΣS_d, f_ℓ) round-major under 'ragged'
+    ghalos,                 # per-layer gradient-halo carries (same shapes)
+    bases,                  # per-layer delta baselines (or dummies):
+    #                         (k, S, f_ℓ) dense, (ΣS_d, f_ℓ) under 'ragged'
     activation: str = "relu",
     final_activation: str = "none",
     ell_buckets: tuple | None = None,
@@ -197,14 +200,23 @@ def gcn_forward_local_stale(
     gwire_dtype: str | None = None,  # static: gradient-wire dtype
     fresh: bool = False,            # static: full-sync step (exact math)
     gauges: bool = False,           # static: emit per-layer drift gauges
+    comm_schedule: str = "a2a",     # static: 'a2a' (pspmm_stale) or
+    #                                 'ragged' (pspmm_stale_ragged — the
+    #                                 composed mode, docs/comm_schedule.md)
+    rr_sizes: tuple | None = None,  # static plan.rr_sizes (ragged)
+    rr_edge_sizes: tuple | None = None,  # static plan.rr_edge_sizes (ragged)
     axis_name: str = AXIS,
 ):
     """Per-chip forward under the pipelined stale-halo exchange.
 
     Same layer math and project-first scheduling as ``gcn_forward_local``,
-    but every aggregation goes through ``ops.pspmm.pspmm_stale``: layer ℓ
-    consumes ``halos[ℓ]`` (exchanged during step t−1) and issues step t's
-    exchange with no in-step consumer.  Returns
+    but every aggregation goes through a stale op: layer ℓ consumes
+    ``halos[ℓ]`` (exchanged during step t−1) and issues step t's exchange
+    with no in-step consumer.  ``comm_schedule`` selects the transport the
+    carry rides: the dense a2a (``pspmm_stale``, ``(R, f)`` carries) or the
+    per-round ppermute ring (``pspmm_stale_ragged``, round-major
+    ``(Σ_d S_d, f)`` carries — the composed mode, in which the k−1 ring
+    rounds leave the critical path too).  Returns
     ``(out, new_halos, new_bases)``; the gradient-halo carries come back as
     the ``ghalos`` cotangents of ``jax.value_and_grad`` (see
     ``pspmm_stale``).  Symmetric-Â plans only — the trainer gates on
@@ -213,15 +225,25 @@ def gcn_forward_local_stale(
     ``gauges=True`` (the telemetry program the trainer compiles when a
     ``RunRecorder`` is attached) additionally returns a per-layer list of
     halo-delta quantization residuals: ``Σ (full − base_next)²`` over the
-    padded send buffer, which is EXACTLY this step's wire rounding error
-    ``(full − base) − quantize(full − base)`` since ``base_next = base +
-    quantized_wire`` — zero when ``delta`` is off (the f32 wire is exact).
-    The extra ``(k, S, f)`` gather per layer exists only in the gauged
-    program; the default hot path is untouched.
+    send buffer (dense ``(k, S, f)``, ragged ``(Σ_d S_d, f)``), which is
+    EXACTLY this step's wire rounding error ``(full − base) −
+    quantize(full − base)`` since ``base_next = base + quantized_wire`` —
+    zero when ``delta`` is off (the f32 wire is exact) and zero on sync
+    steps (the re-base wire is full f32).  The extra send-buffer gather per
+    layer exists only in the gauged program; the default hot path is
+    untouched.
     """
     if ell_buckets is None:
         raise ValueError(
             "stale GCN forward needs the plan's static ell_buckets")
+    if comm_schedule not in ("a2a", "ragged"):
+        raise ValueError(f"unknown comm_schedule {comm_schedule!r} "
+                         "(the trainer resolves 'auto' before the forward)")
+    if comm_schedule == "ragged" and (rr_sizes is None
+                                      or rr_edge_sizes is None):
+        raise ValueError(
+            "composed stale-ragged forward needs the plan's static "
+            "rr_sizes + rr_edge_sizes (CommPlan.ensure_ragged)")
     act = get_activation(activation)
     fact = get_activation(final_activation)
     nl = len(params)
@@ -232,15 +254,27 @@ def gcn_forward_local_stale(
         project_first = (w.shape[1] < h.shape[1]
                          and h.shape[1] >= PROJECT_FIRST_MIN_FIN)
         x = (h @ w) if project_first else h
-        z, hn, bn = pspmm_stale(
-            x, halos[i], ghalos[i], bases[i],
-            pa["send_idx"], pa["halo_src"], pa["ell_idx"], pa["ell_w"],
-            pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
-            pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
-            ell_buckets, axis_name, delta, wire_dtype, gwire_dtype, fresh)
+        if comm_schedule == "ragged":
+            z, hn, bn = pspmm_stale_ragged(
+                x, halos[i], ghalos[i], bases[i], pa["rsend_idx"],
+                pa["ell_idx"], pa["ell_w"],
+                pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
+                pa["redge_dst"], pa["redge_src"], pa["redge_w"],
+                ell_buckets, rr_sizes, rr_edge_sizes, axis_name, delta,
+                wire_dtype, gwire_dtype, fresh)
+        else:
+            z, hn, bn = pspmm_stale(
+                x, halos[i], ghalos[i], bases[i],
+                pa["send_idx"], pa["halo_src"], pa["ell_idx"], pa["ell_w"],
+                pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
+                pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
+                ell_buckets, axis_name, delta, wire_dtype, gwire_dtype,
+                fresh)
         if gauges:
             if delta:
-                full = jnp.take(x, pa["send_idx"], axis=0)
+                sidx = (pa["rsend_idx"] if comm_schedule == "ragged"
+                        else pa["send_idx"])
+                full = jnp.take(x, sidx, axis=0)
                 qerrs.append(jnp.sum(jnp.square(full - bn)))
             else:
                 qerrs.append(jnp.zeros((), x.dtype))

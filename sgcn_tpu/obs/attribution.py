@@ -23,7 +23,10 @@ Three quantities per training step:
     optimizes) and ``halo_bytes_wire`` from what the SELECTED schedule
     actually ships — ``k²·S·f·itemsize`` for the dense a2a,
     ``Σ_d k·S_d·f·itemsize`` for the ragged ppermute ring — at the wire
-    dtype, per step from the exchange count (2·L: forward + backward).
+    dtype, per step from the exchange count (2·L: forward + backward),
+    with a PER-DIRECTION itemsize split when the two directions ride
+    different dtypes (the ``--halo-delta`` feature wire vs the
+    ``--halo-dtype`` gradient wire — see ``step_cost``).
     The exposed-comm attribution charges wire bytes (what crosses ICI),
     never the under-count the true volume would give on a padded schedule.
 
@@ -110,14 +113,20 @@ class StepCostModel:
 
 
 def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
-              wire_itemsize: int | None = None,
+              wire_itemsize=None,
               comm_schedule: str = "a2a",
               model: str = "gcn") -> StepCostModel:
     """Build the cost model for one (plan, layer-stack) pair.
 
     ``compute_dtype='bfloat16'`` halves the gather/wire itemsize (the
     packed bf16 path); ``wire_itemsize`` overrides the wire bytes alone
-    (the ``--halo-dtype bfloat16`` wire-only lever).  ``comm_schedule``
+    (the ``--halo-dtype bfloat16`` wire-only lever).  It takes either one
+    int for BOTH exchange directions, or a ``(fwd, bwd)`` pair (entries
+    ``None`` = the compute itemsize) — the PER-STEP itemsize split: under
+    ``--halo-delta`` the feature wire is bf16 on stale steps and full f32
+    on re-base sync steps while the gradient wire follows ``--halo-dtype``,
+    so the trainer builds one cost model per step kind and a single
+    blended number would misstate both directions.  ``comm_schedule``
     selects the wire-byte model: the plan's TRUE volume (Σ(λ−1)) is
     schedule-independent, but the shipped bytes are the schedule's padded
     buffer — ``plan.wire_rows_per_exchange(schedule)``.
@@ -138,13 +147,20 @@ def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
         from ..models.gat import gat_exchange_lane_widths
         plan.ensure_cell()
         fs = gat_exchange_lane_widths(list(widths), compute_dtype)
-        itemsize = wire_b = 4           # lanes are f32 equivalents
+        itemsize = 4                    # lanes are f32 equivalents
+        wire_f = wire_bwd = 4
         # combined-edge work per layer: bucketed slots + hub tail
         nnz = sum(nb * wb for nb, wb in plan.cell_buckets) + int(plan.ctl)
     else:
         from ..models.gcn import exchange_widths
         itemsize = 2 if compute_dtype == "bfloat16" else 4
-        wire_b = itemsize if wire_itemsize is None else wire_itemsize
+        if wire_itemsize is None:
+            wire_f = wire_bwd = itemsize
+        elif isinstance(wire_itemsize, (tuple, list)):
+            wire_f, wire_bwd = (itemsize if x is None else int(x)
+                                for x in wire_itemsize)
+        else:
+            wire_f = wire_bwd = int(wire_itemsize)
         fs = exchange_widths(fin, list(widths))
         nnz = int(plan.nnz.max()) if plan.nnz.size else 0
     dims = list(zip([fin] + list(widths)[:-1], widths))
@@ -152,22 +168,29 @@ def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
     send_rows = int(plan.predicted_send_volume.sum())
     wire_rows = int(plan.wire_rows_per_exchange(comm_schedule))
 
+    # per-layer bytes are PER EXCHANGE at the mean of the two directions'
+    # itemsizes, so 2L × per-layer == the per-step totals exactly (the
+    # split values are 2/4, whose sum is always even)
     per_layer, spmm_f, dense_f = [], 0, 0
+    true_step = wire_step = 0
     for (fi, fo), w in zip(dims, fs):
         lf_spmm = 2 * nnz * w           # one multiply-add per (edge, lane)
         lf_dense = 2 * b * fi * fo
-        hb = send_rows * w * wire_b
-        hbw = wire_rows * w * wire_b
+        hb2 = send_rows * w * (wire_f + wire_bwd)    # fwd + bwd of layer w
+        hbw2 = wire_rows * w * (wire_f + wire_bwd)
         per_layer.append({"width": int(w), "spmm_flops": int(lf_spmm),
-                          "dense_flops": int(lf_dense), "halo_bytes": int(hb),
-                          "halo_bytes_true": int(hb),
-                          "halo_bytes_wire": int(hbw)})
+                          "dense_flops": int(lf_dense),
+                          "halo_bytes": int(hb2 // 2),
+                          "halo_bytes_true": int(hb2 // 2),
+                          "halo_bytes_wire": int(hbw2 // 2)})
         spmm_f += lf_spmm
         dense_f += lf_dense
+        true_step += hb2
+        wire_step += hbw2
     halo_per_ex = sum(pl["halo_bytes"] for pl in per_layer) // max(
         len(per_layer), 1)
-    true_step = int(2 * sum(pl["halo_bytes_true"] for pl in per_layer))
-    wire_step = int(2 * sum(pl["halo_bytes_wire"] for pl in per_layer))
+    true_step = int(true_step)
+    wire_step = int(wire_step)
     if model == "gat":
         # fwd + bwd table-gather streams: per layer, one gathered row per
         # combined slot/tail edge plus the SELECTED transport's exchange

@@ -109,6 +109,14 @@ class RunRecorder:
         self.manifest["partitioner"] = _jsonable(partitioner)
         self._write_manifest()
 
+    def set_comm_schedule(self, decision: dict) -> None:
+        """Record the transport-selection decision log
+        (``parallel/plan.py::resolve_comm_schedule``): what was asked, what
+        resolved, which rule fired, and the wire-row inputs — so an
+        ``auto`` pick is reconstructible from the run directory alone."""
+        self.manifest["comm_schedule"] = _jsonable(decision)
+        self._write_manifest()
+
     def set_backend(self, mesh=None) -> None:
         """Record the live jax backend + mesh (call after backend init)."""
         import jax

@@ -89,10 +89,20 @@ COMM_SCHEDULES = ("a2a", "ragged", "mixed")
 DRIFT_KEYS = ("staleness_age", "sync_step", "halo_drift_rms",
               "halo_drift_rel", "halo_quant_err_rms")
 
+# One OPTIONAL drift field, validated when present (see validate_event):
+# ``round_age`` is the composed (stale × ragged) mode's per-round
+# staleness-age vector — one entry per ring round, the age of the buffer
+# this step CONSUMED (0 = received this step, N = carried N steps,
+# null = empty round, ships nothing).
+
 _MANIFEST_REQUIRED = {"v": _NUM, "ts": _NUM, "run_kind": _STR, "config": dict}
 _MANIFEST_OPTIONAL = {
     "argv": list, "git_rev": (str, type(None)), "backend": dict,
     "mesh": dict, "plan": dict, "partitioner": (dict, type(None)),
+    # resolve_comm_schedule's decision log (asked/resolved/rule + the
+    # wire-row inputs) — how an 'auto' transport pick is reconstructible
+    # from the run directory alone
+    "comm_schedule": dict,
 }
 
 
@@ -172,6 +182,15 @@ def validate_event(ev: dict) -> None:
             raise ValueError(
                 f"step event drift block missing {missing} "
                 f"(must carry every DRIFT_KEYS field)")
+        ra = ev["drift"].get("round_age")
+        if ra is not None:
+            if not isinstance(ra, list) or any(
+                    not (x is None or (isinstance(x, _NUM)
+                                       and not isinstance(x, bool)
+                                       and x >= 0)) for x in ra):
+                raise ValueError(
+                    f"drift round_age must be a list of null / non-negative "
+                    f"ages (one per ring round), got {ra!r}")
 
 
 def validate_manifest(m: dict) -> None:

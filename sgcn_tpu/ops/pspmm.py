@@ -543,19 +543,24 @@ def _stale_exchange(x, halo_in, base_in, send_idx, halo_src, axis_name,
     quantized increment — the sender into ``base`` (its model of what every
     receiver holds), the receiver into its cached halo — so the two stay in
     exact lockstep and quantization error never compounds into disagreement.
-    A ``fresh`` step re-bases (sends the full value against a zero base),
-    bounding accumulated rounding drift to one bf16 rounding of the row.
+    A ``fresh`` step re-bases with the FULL f32 row on the wire: both ends
+    reset to the exact value, so accumulated rounding drift goes to zero
+    (not to one more bf16 rounding) and a delta run at ``sync_every=1`` is
+    exact-mode math.  The attribution model charges these steps the f32
+    wire itemsize (``obs/attribution.py`` — the per-step itemsize split).
     """
     full = jnp.take(x, send_idx, axis=0)                     # (k, S, f)
     if delta:
+        if fresh:
+            recv = a2a_or_identity(full, axis_name)
+            flat = recv.reshape(-1, x.shape[-1])
+            return jnp.take(flat, halo_src, axis=0), full
         wdt = jnp.bfloat16 if wire_dtype is None else jnp.dtype(wire_dtype)
-        base = jnp.zeros_like(full) if fresh else base_in
-        wire = (full - base).astype(wdt)
+        wire = (full - base_in).astype(wdt)
         recv = a2a_or_identity(wire, axis_name)
         flat = recv.reshape(-1, x.shape[-1]).astype(x.dtype)
         inc = jnp.take(flat, halo_src, axis=0)
-        prev = jnp.zeros_like(inc) if fresh else halo_in
-        return prev + inc, base + wire.astype(base.dtype)
+        return halo_in + inc, base_in + wire.astype(base_in.dtype)
     halo_next = halo_exchange(x, send_idx, halo_src, axis_name, wire_dtype)
     return halo_next, base_in
 
@@ -636,3 +641,179 @@ def _pspmm_stale_bwd(buckets, axis_name, delta, wire_dtype, gwire_dtype,
 
 
 pspmm_stale.defvjp(_pspmm_stale_fwd, _pspmm_stale_bwd)
+
+
+# ------------------------------------------------------------- stale × ragged
+# The composed mode (PipeGCN-complete): the one-step-stale carry of
+# ``pspmm_stale`` ON the per-round ppermute ring of ``pspmm_ragged_sym`` —
+# both perf levers at once.  The carry is ROUND-STRUCTURED: instead of the
+# dense ``(R, f)`` halo table (gathered out of a globally-padded ``(k, S)``
+# receive window), each layer carries the ring's receive buffers themselves,
+# round-major — round d of the ring occupies slots ``[Σ_{d'<d} S_{d'},
+# Σ_{d'<d} S_{d'} + S_d)`` of a ``(Σ_d S_d, f)`` table (``CommPlan.rr_sizes``
+# sizes the rounds; empty rounds occupy zero slots and vanish at trace time).
+# The fold consumes the carry through the SAME per-round ``redge_*``
+# scatter-add sequence as ``_ragged_remote``, so a full-sync step is
+# f32-bit-identical to the exact ragged path (and hence to the dense exact
+# path — the PR-4 parity contract chains through), while a stale step's
+# per-round exchanges have no same-step consumer at all: round d of step t's
+# ppermute rides behind round d+1's fold of the CARRIED buffers and behind
+# every local slot pass.  The bf16 halo-delta cache composes per round: each
+# round's wire carries its own quantized increment against a round-slice of
+# the (ring-shaped, not ``(k, S, f)``) baseline.
+
+
+def _stale_ragged_exchange(x, halo_in, base_in, rsend_idx, rr_sizes,
+                           axis_name, delta, wire_dtype, fresh):
+    """Issue step t's per-round ring exchange; return ``(halo_next,
+    base_next)`` in the round-major carry layout described above.
+
+    Per live round: ``delta`` stale steps ship the bf16 increment against
+    the round's baseline slice and BOTH ends accumulate it (the
+    ``_stale_exchange`` lockstep contract, per round); a ``fresh`` delta
+    step re-bases with the full f32 buffer (exact, drift reset to zero);
+    non-delta rounds ship the full value at ``wire_dtype`` — exactly the
+    exact-mode ring's wire, so a full-sync step receives the exact ragged
+    exchange's bits."""
+    segs_h, segs_b = [], []
+    off = 0
+    for d, sd in enumerate(rr_sizes, start=1):
+        if sd == 0:
+            continue
+        full = jnp.take(x, rsend_idx[off: off + sd], axis=0)   # (S_d, f)
+        if delta and not fresh:
+            wdt = (jnp.bfloat16 if wire_dtype is None
+                   else jnp.dtype(wire_dtype))
+            base = base_in[off: off + sd]
+            wire = (full - base).astype(wdt)
+            recv = ppermute_or_identity(wire, axis_name, d)
+            segs_h.append(halo_in[off: off + sd]
+                          + recv.astype(x.dtype))
+            segs_b.append(base + wire.astype(base.dtype))
+        else:
+            buf = full
+            if not delta and wire_dtype is not None:
+                buf = buf.astype(wire_dtype)
+            recv = ppermute_or_identity(buf, axis_name, d)
+            segs_h.append(recv.astype(x.dtype))
+            if delta:                       # fresh re-base: exact f32 wire
+                segs_b.append(full)
+        off += sd
+    if not segs_h:                          # k=1 / all-empty ring: (1, f) dummy
+        return halo_in, base_in
+    halo_next = segs_h[0] if len(segs_h) == 1 else jnp.concatenate(segs_h)
+    if not delta:
+        return halo_next, base_in
+    base_next = segs_b[0] if len(segs_b) == 1 else jnp.concatenate(segs_b)
+    return halo_next, base_next
+
+
+def _stale_ragged_fold(halo_tab, redge_dst, redge_src, redge_w,
+                       rr_sizes, rr_edge_sizes, num_rows: int):
+    """Σ_d (round-d scatter-add of Â_halo·carry_d): ``_ragged_remote``'s
+    fold with the round receive buffers read from the round-major carry
+    table instead of this step's wire — same per-slot addition sequence,
+    so consuming a FRESH carry reproduces the exact ragged path's bits."""
+    remote = jnp.zeros((num_rows, halo_tab.shape[-1]), halo_tab.dtype)
+    off_s = off_e = 0
+    for sd, ed in zip(rr_sizes, rr_edge_sizes):
+        if sd == 0:
+            off_e += ed
+            continue
+        recv = halo_tab[off_s: off_s + sd]
+        g = (jnp.take(recv, redge_src[off_e: off_e + ed], axis=0)
+             * redge_w[off_e: off_e + ed, None])
+        remote = remote.at[redge_dst[off_e: off_e + ed]].add(
+            g, indices_are_sorted=True)
+        off_s += sd
+        off_e += ed
+    return remote
+
+
+def _pspmm_stale_ragged_once(x, halo_in, base_in, rsend_idx, ell_idx, ell_w,
+                             ltail_dst, ltail_src, ltail_w,
+                             redge_dst, redge_src, redge_w,
+                             buckets, rr_sizes, rr_edge_sizes, axis_name,
+                             delta, wire_dtype, fresh):
+    halo_next, base_next = _stale_ragged_exchange(
+        x, halo_in, base_in, rsend_idx, rr_sizes, axis_name, delta,
+        wire_dtype, fresh)
+    # stale step: the fold reads the CARRY — no round of this step's ring
+    # has a same-step consumer, so every ppermute rides behind compute;
+    # fresh (sync) step: the fold waits round by round, exactly the exact
+    # ragged path's fold-as-you-arrive dependence structure
+    halo_used = halo_next if fresh else halo_in
+    local = spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, x, buckets)
+    remote = _stale_ragged_fold(halo_used, redge_dst, redge_src, redge_w,
+                                rr_sizes, rr_edge_sizes, x.shape[0])
+    return local + remote, halo_next, base_next
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(13, 14, 15, 16, 17, 18, 19, 20))
+def pspmm_stale_ragged(x, halo_in, ghalo_in, base_in, rsend_idx,
+                       ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+                       redge_dst, redge_src, redge_w,
+                       buckets, rr_sizes, rr_edge_sizes,
+                       axis_name=AXIS, delta=False, wire_dtype=None,
+                       gwire_dtype=None, fresh=False):
+    """``PSpMM`` with a one-step-stale ROUND-STRUCTURED halo carry — the
+    composition of ``pspmm_stale``'s pipelined contract with
+    ``pspmm_ragged_sym``'s per-round ppermute ring.
+
+    Forward: ``out = Â_local·x + fold(halo_in)`` where ``halo_in`` is the
+    round-major receive-buffer carry exchanged during step t−1, and step
+    t's k−1 per-round ppermutes are issued into ``halo_next`` with no
+    in-step consumer.  Backward (symmetric Â): ``g_x = Â_local·g +
+    fold(ghalo_in)`` and the fresh gradient ring exchange leaves through
+    the ``ghalo_in`` cotangent channel — the same deliberate plumbing as
+    ``pspmm_stale`` (differentiate the caller w.r.t. its ``ghalos`` carry
+    and the "grad" that comes back IS next step's carry).  ``fresh=True``
+    compiles the full-sync step: both carries are consumed fresh, which is
+    f32-bit-identical to the exact ragged path (``tests/test_stale_ragged``
+    pins the ``sync_every=1`` trajectory ``==`` the dense exact one).
+
+    Returns ``(out, halo_next, base_next)``; the carries are aux outputs
+    whose cotangents are structurally zero (they cross the step boundary).
+    Symmetric-Â only, like every ragged/stale op.
+    """
+    return _pspmm_stale_ragged_once(
+        x, halo_in, base_in, rsend_idx, ell_idx, ell_w,
+        ltail_dst, ltail_src, ltail_w, redge_dst, redge_src, redge_w,
+        buckets, rr_sizes, rr_edge_sizes, axis_name, delta, wire_dtype,
+        fresh)
+
+
+def _pspmm_stale_ragged_fwd(x, halo_in, ghalo_in, base_in, rsend_idx,
+                            ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+                            redge_dst, redge_src, redge_w,
+                            buckets, rr_sizes, rr_edge_sizes, axis_name,
+                            delta, wire_dtype, gwire_dtype, fresh):
+    out = _pspmm_stale_ragged_once(
+        x, halo_in, base_in, rsend_idx, ell_idx, ell_w,
+        ltail_dst, ltail_src, ltail_w, redge_dst, redge_src, redge_w,
+        buckets, rr_sizes, rr_edge_sizes, axis_name, delta, wire_dtype,
+        fresh)
+    res = (ghalo_in, rsend_idx, ell_idx, ell_w, ltail_dst, ltail_src,
+           ltail_w, redge_dst, redge_src, redge_w)
+    return out, res
+
+
+def _pspmm_stale_ragged_bwd(buckets, rr_sizes, rr_edge_sizes, axis_name,
+                            delta, wire_dtype, gwire_dtype, fresh, res, cts):
+    (ghalo_in, rsend_idx, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+     redge_dst, redge_src, redge_w) = res
+    g, _, _ = cts            # carry cotangents are structurally zero
+    # step t's gradient ring exchange: full-value wire at gwire_dtype (the
+    # delta cache is a feature-wire lever), no same-step consumer on stale
+    # steps — it leaves through the ghalo_in cotangent channel
+    gh_next, _ = _stale_ragged_exchange(
+        g, ghalo_in, ghalo_in, rsend_idx, rr_sizes, axis_name, False,
+        gwire_dtype, fresh)
+    gh_used = gh_next if fresh else ghalo_in
+    gx = (spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, g, buckets)
+          + _stale_ragged_fold(gh_used, redge_dst, redge_src, redge_w,
+                               rr_sizes, rr_edge_sizes, g.shape[0]))
+    return (gx, None, gh_next, None, *[None] * 9)
+
+
+pspmm_stale_ragged.defvjp(_pspmm_stale_ragged_fwd, _pspmm_stale_ragged_bwd)

@@ -70,6 +70,19 @@ RAGGED_AUTO_EFFICIENCY = 0.5
 # pass through a per-chip slice untouched.
 _GLOBAL_ARRAY_FIELDS = ("owner", "local_idx", "chip_ids")
 
+# Plan arrays the COMPOSED stale × ragged step ships to devices
+# (``ops.pspmm.pspmm_stale_ragged``): the ragged ring's send/edge layout —
+# the round-structured carries replace the dense send_idx/halo_src pair
+# entirely (receives live in the carry, the fold rides redge_*).  Kept as
+# its own contract tuple (same lint coverage as the model tuples,
+# ``tests/test_plan_contract.py``) even though it currently equals the
+# ragged GCN forward's field set — the two evolve for different reasons.
+STALE_PLAN_FIELDS_RAGGED = (
+    "rsend_idx", "ell_idx", "ell_w",
+    "ltail_dst", "ltail_src", "ltail_w",
+    "redge_dst", "redge_src", "redge_w",
+)
+
 
 @dataclass
 class CommPlan:
@@ -413,11 +426,13 @@ class CommPlan:
         return self
 
     # ------------------------------------------------------------ stale halo
-    def stale_carry_shapes(self, fin: int, widths, delta: bool = False) -> dict:
+    def stale_carry_shapes(self, fin: int, widths, delta: bool = False,
+                           comm_schedule: str = "a2a") -> dict:
         """Per-layer carry shapes (WITHOUT the stacked leading k axis) for
-        the pipelined stale-halo mode (``ops.pspmm.pspmm_stale``).
+        the pipelined stale-halo mode, SCHEDULE-AWARE.
 
-        ``halos[ℓ]`` / ``ghalos[ℓ]``: the ``(R, f_ℓ)`` feature- and
+        ``comm_schedule='a2a'`` (``ops.pspmm.pspmm_stale``):
+        ``halos[ℓ]`` / ``ghalos[ℓ]`` are the ``(R, f_ℓ)`` feature- and
         gradient-halo buffers carried across steps, where ``f_ℓ`` is the
         layer's EXCHANGED row width under the trainer's project-first rule
         (``models.gcn.exchange_widths`` — the single shared encoding of that
@@ -425,10 +440,32 @@ class CommPlan:
         ``bases[ℓ]``: the sender-side ``(k, S, f_ℓ)`` delta baseline when
         ``delta`` (the halo-delta cache), else a ``(1, 1, 1)`` placeholder
         so the carry pytree keeps one static structure per mode.
+
+        ``comm_schedule='ragged'`` (``ops.pspmm.pspmm_stale_ragged``): the
+        carries are ROUND-STRUCTURED — ``(Σ_d S_d, f_ℓ)`` round-major ring
+        receive buffers (round d occupies its own ``rr_sizes[d-1]``-row
+        slice), NOT the dense ``(R, f)`` halo table, and the delta baseline
+        shrinks from ``(k, S, f_ℓ)`` to the same ``(Σ_d S_d, f_ℓ)`` ring
+        envelope (placeholder ``(1, 1)``).  Requires ``ensure_ragged()``
+        first — the round sizes ARE the carry layout.
         """
         from ..models.gcn import exchange_widths   # deferred: avoids a cycle
 
         fs = exchange_widths(fin, list(widths))
+        if comm_schedule == "ragged":
+            if self.rr_sizes is None:
+                raise ValueError(
+                    "round-structured stale carries need the ragged layout; "
+                    "call ensure_ragged() before stale_carry_shapes("
+                    "comm_schedule='ragged')")
+            st = max(1, sum(self.rr_sizes))
+            return {
+                "halos": [(st, f) for f in fs],
+                "ghalos": [(st, f) for f in fs],
+                "bases": [((st, f) if delta else (1, 1)) for f in fs],
+            }
+        if comm_schedule != "a2a":
+            raise ValueError(f"unknown comm_schedule {comm_schedule!r}")
         peers = self.send_idx.shape[1]   # == k on a full plan; kept explicit
                                          # so a shard-proxy slice stays right
         return {
@@ -497,63 +534,100 @@ class CommPlan:
 def resolve_comm_schedule(schedule: str | None, plans, model: str,
                           halo_staleness: int = 0,
                           fin: int | None = None, widths=None,
-                          compute_dtype: str | None = None) -> str:
+                          compute_dtype: str | None = None,
+                          decision: dict | None = None) -> str:
     """Resolve a ``comm_schedule`` knob to a concrete transport — THE one
     selection rule shared by both trainers (a second copy would drift).
 
     ``None`` reads ``$SGCN_COMM_SCHEDULE`` (default ``'a2a'``).  ``'auto'``
     is a PREFERENCE: it picks ``'ragged'`` only when every plan supports it
-    (symmetric, exact mode, full square counts or a pre-built ragged
-    layout, k > 1), the aggregate dense padding efficiency falls below
-    ``RAGGED_AUTO_EFFICIENCY``, AND the choice does not forfeit the Pallas
-    VMEM aggregator (GCN only — the ragged fold is pinned to the ELL path,
-    so in the VMEM regime (``use_pallas_spmm``) the kernel's measured win
-    outweighs the wire padding and a2a stays; GAT has no VMEM aggregator
-    to forfeit).  Everything else resolves to ``'a2a'`` silently.  An
-    explicit ``'ragged'`` is a CONTRACT — callers validate it loudly
-    themselves.
+    (symmetric, full square counts or a pre-built ragged layout, k > 1) and
+    the cost rule below says so; everything else resolves to ``'a2a'``
+    silently.  An explicit ``'ragged'`` is a CONTRACT — callers validate it
+    loudly themselves.
 
-    The scored quantity IS the wire-byte efficiency of the model's real
-    exchange tables: every exchange of a plan ships the same row set at
-    every lane width (GCN's ``exchange_widths`` rows, GAT's
-    ``gat_exchange_lane_widths`` tables — fused ``fout+1`` vs packed
-    ``fout/2+1``), so the per-layer lane weights multiply true and wire
-    bytes uniformly and the byte ratio REDUCES EXACTLY to the row ratio
-    computed below — no separate lane arithmetic is needed here, and the
-    selection is provably identical for every table form.  The lane widths
-    live where bytes genuinely differ: the attribution/CommStats
-    ``halo_bytes_true``/``halo_bytes_wire`` gauges.  ``compute_dtype`` is
-    accepted for signature stability with those byte models; it cannot
-    change the ratio.
+    TWO cost rules, because staleness changes what the wire costs:
+
+    * **exact mode** (``halo_staleness=0``): the latency trade — the ring
+      issues k−1 collectives where the dense schedule issues one, so ragged
+      only pays when the aggregate dense padding efficiency falls below
+      ``RAGGED_AUTO_EFFICIENCY``, AND the choice must not forfeit the
+      Pallas VMEM aggregator (GCN only — the ragged fold is pinned to the
+      ELL path; GAT has no VMEM aggregator to forfeit).
+    * **stale mode** (``halo_staleness=1``): the exchange is HIDDEN — no
+      same-step consumer, so its latency (the k−1 dispatches included) is
+      off the critical path and the padding-efficiency threshold would be
+      measuring a cost that is not being paid.  The only remaining cost is
+      wire bytes (ICI occupancy/energy, and the sync steps' exposed
+      exchange), so ragged wins whenever it ships strictly fewer wire rows
+      than the dense pad.  (The stale trainer never selects the Pallas
+      aggregator, so no VMEM exception applies.)
+
+    The scored quantity is the wire-byte efficiency of the model's real
+    exchange tables in both rules: every exchange of a plan ships the same
+    row set at every lane width (GCN's ``exchange_widths`` rows, GAT's
+    ``gat_exchange_lane_widths`` tables), so the per-layer lane weights
+    multiply true and wire bytes uniformly and the byte ratio REDUCES
+    EXACTLY to the row ratio — the lane arithmetic lives in the
+    attribution/CommStats byte gauges.  ``compute_dtype`` is accepted for
+    signature stability with those byte models; it cannot change the ratio.
+
+    ``decision`` (optional dict, filled in place): the selection's inputs
+    and the rule that fired — the trainers stash it and ``attach_recorder``
+    logs it into the run manifest (``comm_schedule`` block), so an ``auto``
+    pick is reconstructible from the run directory alone.
     """
     import os
     del compute_dtype       # lane weights cancel in the ratio (see above)
+    log = decision if decision is not None else {}
+    asked = schedule
     if schedule is None:
         schedule = os.environ.get("SGCN_COMM_SCHEDULE", "a2a")
+        asked = f"${{SGCN_COMM_SCHEDULE}}={schedule}"
     if schedule not in ("a2a", "ragged", "auto"):
         raise ValueError(
             f"comm_schedule must be 'a2a', 'ragged' or 'auto', got "
             f"{schedule!r}")
+    log.update(asked=asked, model=model, halo_staleness=int(halo_staleness))
+
+    def resolved(value: str, rule: str) -> str:
+        log.update(resolved=value, rule=rule)
+        return value
+
     if schedule != "auto":
-        return schedule
-    if model not in ("gcn", "gat") or halo_staleness:
-        return "a2a"
-    true = wire = 0
+        return resolved(schedule, "explicit")
+    if model not in ("gcn", "gat"):
+        return resolved("a2a", "model has no ragged transport")
+    true = wire = wire_ragged = 0
     for p in plans:
         sc = np.asarray(p.send_counts)
         ragged_ready = (p.rr_sizes is not None
                         or (sc.ndim == 2 and sc.shape[0] == sc.shape[1]))
         if not (p.symmetric and ragged_ready and sc.shape[1] > 1):
-            return "a2a"
+            return resolved("a2a", "plan does not support the ragged ring "
+                                   "(asymmetric, sliced, or k == 1)")
         true += int(sc.sum())
         wire += p.wire_rows_per_exchange("a2a")
+        wire_ragged += p.wire_rows_per_exchange("ragged")
+    log.update(true_rows=true, wire_rows_a2a=wire,
+               wire_rows_ragged=wire_ragged,
+               padding_efficiency=(true / wire if wire else 1.0),
+               threshold=RAGGED_AUTO_EFFICIENCY)
+    if halo_staleness:
+        # hidden exchange: bytes-only rule (see docstring)
+        if wire_ragged < wire:
+            return resolved("ragged", "hidden-exchange wire-byte rule: "
+                                      "ragged ships fewer wire rows")
+        return resolved("a2a", "hidden-exchange wire-byte rule: ragged "
+                               "ships no fewer wire rows")
     if not wire or true / wire >= RAGGED_AUTO_EFFICIENCY:
-        return "a2a"
+        return resolved("a2a", "padding efficiency at/above threshold")
     if model == "gcn" and fin is not None and widths is not None:
         from ..ops.pallas_spmm import use_pallas_spmm   # deferred: jax
         if use_pallas_spmm(plans[0], fin, widths):
-            return "a2a"
-    return "ragged"
+            return resolved("a2a", "Pallas VMEM aggregator would be "
+                                   "forfeited (GCN exception)")
+    return resolved("ragged", "padding efficiency below threshold")
 
 
 def _relabel(n: int, partvec: np.ndarray, k: int, pad_rows_to: int,

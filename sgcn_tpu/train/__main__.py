@@ -78,10 +78,14 @@ def main() -> None:
                         "ragged = per-round-sized ppermute ring (same "
                         "math, bit-identical f32 losses, fewer wire bytes "
                         "on skewed partitions; symmetric adjacency — GCN "
-                        "ships feature rows, GAT its attention tables); "
-                        "auto = ragged when the plan's padding efficiency "
-                        "drops below 0.5.  Default: $SGCN_COMM_SCHEDULE, "
-                        "else a2a")
+                        "ships feature rows, GAT its attention tables; "
+                        "composes with --halo-staleness 1: the carry "
+                        "becomes round-structured and BOTH perf levers "
+                        "apply); auto = ragged when the plan's padding "
+                        "efficiency drops below 0.5 (under staleness: "
+                        "whenever ragged ships fewer wire rows — the "
+                        "hidden exchange makes latency moot).  Default: "
+                        "$SGCN_COMM_SCHEDULE, else a2a")
     p.add_argument("--epochs", type=int, default=4)
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--lr", type=float, default=0.01)
@@ -151,13 +155,15 @@ def main() -> None:
         raise SystemExit(
             "--halo-delta/--sync-every configure the stale pipelined "
             "exchange; add --halo-staleness 1")
-    if args.comm_schedule == "ragged" and (args.halo_staleness
-                                           or args.experiment == "accuracy"):
+    # --comm-schedule ragged composes with --halo-staleness 1 since the
+    # round-structured stale carry (pspmm_stale_ragged); the remaining
+    # genuinely unsupported combo is the accuracy-parity harness, which is
+    # defined for the default transport only
+    if args.comm_schedule == "ragged" and args.experiment == "accuracy":
         raise SystemExit(
-            "--comm-schedule ragged is the exact-mode transport "
-            "(composition with --halo-staleness 1 is deferred; the "
-            "accuracy-parity harness is defined for the default transport) "
-            "— drop the conflicting flag or use --comm-schedule auto")
+            "--comm-schedule ragged: the accuracy-parity harness is "
+            "defined for the default transport — drop the conflicting "
+            "flag or use --comm-schedule auto")
 
     if args.metrics_out:
         # before any heavy import: heartbeat() in the launch/backend layers

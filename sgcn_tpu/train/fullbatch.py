@@ -251,13 +251,17 @@ class FullBatchTrainer:
         ``send_counts`` is skewed; ``'auto'`` picks ragged when the plan's
         dense padding efficiency falls below ``RAGGED_AUTO_EFFICIENCY``
         (``parallel/plan.py`` — the wire-byte ratio, which reduces to the
-        row ratio for every table form).  ``None`` reads
-        ``$SGCN_COMM_SCHEDULE`` (default ``'a2a'``).  Model-agnostic: GCN
-        rides the ring with feature rows, GAT with its per-layer attention
-        tables (fused, packed-bf16 and split forms — the split pair's two
-        dense dispatches collapse into one two-lane ring).  Symmetric edge
-        patterns only; composition with ``halo_staleness=1`` is deferred
-        (clean error)."""
+        row ratio for every table form; under ``halo_staleness=1`` the
+        hidden exchange switches ``auto`` to the wire-byte-only rule).
+        ``None`` reads ``$SGCN_COMM_SCHEDULE`` (default ``'a2a'``).
+        Model-agnostic: GCN rides the ring with feature rows, GAT with its
+        per-layer attention tables (fused, packed-bf16 and split forms —
+        the split pair's two dense dispatches collapse into one two-lane
+        ring).  Symmetric edge patterns only.  ``'ragged'`` +
+        ``halo_staleness=1`` is the COMPOSED mode
+        (``ops/pspmm.py::pspmm_stale_ragged``): round-structured carries
+        ride the ring across steps, so both the Σ(λ−1) wire win and the
+        hidden-exchange critical-path win apply at once."""
         if halo_dtype is not None and model != "gcn":
             raise ValueError(
                 "halo_dtype is a GCN-trainer lever; for GAT use "
@@ -298,22 +302,21 @@ class FullBatchTrainer:
         # Pallas VMEM aggregator; an explicit 'ragged' is a contract,
         # validated loudly below
         from ..parallel.plan import resolve_comm_schedule
+        self.comm_decision: dict = {}   # selection inputs → run manifest
         comm_schedule = resolve_comm_schedule(
             comm_schedule, [plan], model, halo_staleness,
-            fin=fin, widths=list(widths), compute_dtype=compute_dtype)
+            fin=fin, widths=list(widths), compute_dtype=compute_dtype,
+            decision=self.comm_decision)
         if comm_schedule == "ragged":
             if not plan.symmetric:
                 raise ValueError(
                     "comm_schedule='ragged' uses the symmetric custom "
                     "backward (the gradient rides the same ppermute ring); "
                     "this plan is asymmetric — run the a2a schedule")
-            if halo_staleness:
-                raise ValueError(
-                    "comm_schedule='ragged' does not compose with "
-                    "halo_staleness=1 yet: the stale carry contract "
-                    "(pspmm_stale) is built around the dense a2a wire — "
-                    "run one lever or the other (deferred composition, "
-                    "docs/comm_schedule.md)")
+            # composition with halo_staleness=1 is SUPPORTED (the round-
+            # structured carry of pspmm_stale_ragged); the staleness gates
+            # above (GCN, symmetric, f32 non-remat) already cover the
+            # genuinely unsupported combos
             plan.ensure_ragged()
         self.comm_schedule = comm_schedule
         self.halo_staleness = halo_staleness
@@ -332,7 +335,9 @@ class FullBatchTrainer:
         # JSONL phase records both read it; sync= callables sit at each
         # block_until_ready boundary)
         self._step_count = 0
-        self._cost = None           # lazy obs.attribution.step_cost model
+        self._cost_cache = {}       # lazy obs.attribution.step_cost models,
+        # keyed by step kind (sync vs stale) — under --halo-delta the
+        # feature wire's itemsize differs between the two (obs glossary)
         self.mesh = mesh if mesh is not None else make_mesh_1d(plan.k)
         self.activation = activation
         self.final_activation = final_activation
@@ -345,9 +350,12 @@ class FullBatchTrainer:
             # the ragged schedule stays on the ELL aggregator (its fold
             # contract is built around the per-owner edge split; the Pallas
             # tile layout is a dense-a2a companion) — mirror of the stale
-            # mode's aggregator pin below
+            # mode's aggregator pin below.  The composed (stale × ragged)
+            # step ships the same ring arrays under its own contract tuple.
             from ..models.gcn import GCN_PLAN_FIELDS_RAGGED
-            self.plan_fields = GCN_PLAN_FIELDS_RAGGED
+            from ..parallel.plan import STALE_PLAN_FIELDS_RAGGED
+            self.plan_fields = (STALE_PLAN_FIELDS_RAGGED if halo_staleness
+                                else GCN_PLAN_FIELDS_RAGGED)
             self._fwd_static = {"ell_buckets": plan.ell_buckets,
                                 "comm_schedule": "ragged",
                                 "rr_sizes": plan.rr_sizes,
@@ -422,15 +430,22 @@ class FullBatchTrainer:
             from ..models.gat import gat_exchange_lane_widths
             lane_widths = tuple(gat_exchange_lane_widths(
                 self.widths, compute_dtype))
-            wire_itemsize = 4       # lanes already encode the narrow dtype
+            wire_itemsize = wire_itemsize_bwd = 4   # lanes encode the dtype
         else:
             from ..models.gcn import exchange_widths
             lane_widths = tuple(exchange_widths(fin, self.widths))
+            # per-DIRECTION wire itemsize (docs/observability.md): the
+            # halo-delta cache narrows only the FEATURE wire (and only on
+            # stale steps — count_step takes a per-step override for the
+            # f32 re-base syncs); the gradient wire follows --halo-dtype
             wire_itemsize = 2 if (halo_dtype == "bfloat16" or halo_delta
                                   or compute_dtype == "bfloat16") else 4
+            wire_itemsize_bwd = 2 if (halo_dtype == "bfloat16"
+                                      or compute_dtype == "bfloat16") else 4
         self.stats = CommStats.from_plan(plan, schedule=comm_schedule,
                                          lane_widths=lane_widths,
-                                         wire_itemsize=wire_itemsize)
+                                         wire_itemsize=wire_itemsize,
+                                         wire_itemsize_bwd=wire_itemsize_bwd)
         self._step = self._build_step()
         self._eval = self._build_eval()
         self._multi = {}        # epochs -> compiled on-device epoch loop
@@ -438,8 +453,11 @@ class FullBatchTrainer:
             # per-layer carry state, stacked per chip and sharded like the
             # plan arrays; zeros are never consumed — the first step (and
             # every sync step) runs the full-sync program, which reads the
-            # FRESH exchange and refreshes every carry as a byproduct
-            shapes = plan.stale_carry_shapes(fin, widths, delta=halo_delta)
+            # FRESH exchange and refreshes every carry as a byproduct.
+            # Under the composed mode the carries are ROUND-STRUCTURED ring
+            # receive buffers (plan.stale_carry_shapes, schedule-aware).
+            shapes = plan.stale_carry_shapes(fin, widths, delta=halo_delta,
+                                             comm_schedule=comm_schedule)
             carry = {
                 name: [np.zeros((plan.k,) + s, np.float32) for s in shps]
                 for name, shps in shapes.items()
@@ -505,6 +523,11 @@ class FullBatchTrainer:
                        fresh: bool, gauges: bool = False):
         from ..models.gcn import gcn_forward_local_stale
 
+        # composed mode: the stale forward rides the ring — pass the static
+        # ring spec through (absent under the dense a2a carry)
+        ragged = {k: self._fwd_static[k]
+                  for k in ("comm_schedule", "rr_sizes", "rr_edge_sizes")
+                  if k in self._fwd_static}
         out = gcn_forward_local_stale(
             params, h0, pa, halos, ghalos, bases,
             activation=self.activation,
@@ -517,6 +540,7 @@ class FullBatchTrainer:
             gwire_dtype=self.halo_dtype,
             fresh=fresh,
             gauges=gauges,
+            **ragged,
         )
         if gauges:
             logits, nh, nb, qe = out
@@ -665,7 +689,12 @@ class FullBatchTrainer:
         if sync_step:
             self._last_sync_idx = self._stale_step_idx
         self._stale_step_idx += 1
-        self.stats.count_step(nlayers=self.nlayers, hidden=not sync_step)
+        # per-step feature-wire itemsize: a delta-mode SYNC step re-bases
+        # with the full f32 row (ops/pspmm.py::_stale_exchange), so its
+        # wire bytes are booked at 4, not the stale steps' bf16 2
+        self.stats.count_step(
+            nlayers=self.nlayers, hidden=not sync_step,
+            wire_itemsize=4 if (self.halo_delta and sync_step) else None)
         return loss, err, extra
 
     def _build_step(self, mesh=None, telemetry: bool = False):
@@ -851,6 +880,11 @@ class FullBatchTrainer:
         the fused loop cannot surface; detach (``recorder=None``) to get the
         one-dispatch path back."""
         self.recorder = recorder
+        if getattr(self, "comm_decision", None):
+            # the schedule-selection inputs (resolve_comm_schedule) land in
+            # the run manifest, so an 'auto' pick is reconstructible from
+            # the run directory alone (docs/observability.md)
+            recorder.set_comm_schedule(self.comm_decision)
         self._step_tel = self._build_step(telemetry=True)
         if self.halo_staleness:
             self._step_stale_tel = self._build_step_stale(
@@ -858,9 +892,40 @@ class FullBatchTrainer:
             self._step_sync_tel = self._build_step_stale(
                 fresh=True, telemetry=True)
 
+    def _step_cost_model(self, sync_step: bool = True):
+        """Per-step-kind analytic cost: under ``--halo-delta`` the FEATURE
+        wire is bf16 on stale steps but full f32 on (re-base) sync steps,
+        while the gradient wire keeps ``--halo-dtype`` — so the cost model
+        takes a per-direction wire-itemsize split and is cached per step
+        kind (the obs glossary documents the split)."""
+        key = bool(sync_step)
+        if key not in self._cost_cache:
+            from ..obs.attribution import step_cost
+            wire = None
+            if self.model == "gcn":
+                if self.halo_delta and sync_step:
+                    # the re-base wire ships the FULL f32 row regardless of
+                    # --halo-dtype (ops/pspmm.py fresh-delta path) — must
+                    # match count_step's wire_itemsize=4 override exactly
+                    fwd = 4
+                elif self.halo_dtype == "bfloat16" or self.halo_delta:
+                    fwd = 2
+                else:
+                    fwd = None
+                bwd = 2 if self.halo_dtype == "bfloat16" else None
+                if fwd is not None or bwd is not None:
+                    wire = (fwd, bwd)
+            self._cost_cache[key] = step_cost(
+                self.plan, self.fin, self.widths,
+                compute_dtype=self.compute_dtype,
+                wire_itemsize=wire,
+                comm_schedule=self.comm_schedule,
+                model=self.model)
+        return self._cost_cache[key]
+
     def _record_step_event(self, loss: float, err, gnorm, wall_s: float,
                            drift: dict | None) -> None:
-        from ..obs.attribution import roofline_fields, step_cost
+        from ..obs.attribution import roofline_fields
 
         roofline = None
         # same honesty gate as bench.py: the gather model describes the
@@ -870,19 +935,12 @@ class FullBatchTrainer:
         # own table-form-aware model (attribution.step_cost(model='gat')),
         # which is what makes the wire gauges reconcile with CommStats'.
         if "pallas_tb" not in self._fwd_static:
-            if self._cost is None:
-                self._cost = step_cost(
-                    self.plan, self.fin, self.widths,
-                    compute_dtype=self.compute_dtype,
-                    wire_itemsize=2 if (self.model == "gcn"
-                                        and (self.halo_dtype == "bfloat16"
-                                             or self.halo_delta)) else None,
-                    comm_schedule=self.comm_schedule,
-                    model=self.model)
+            sync_like = drift is None or bool(drift.get("sync_step"))
+            cost = self._step_cost_model(sync_like)
             ex_step = 2 * self.nlayers      # this step's exchanges
             exposed_step = 0 if (drift is not None
                                  and not drift.get("sync_step")) else ex_step
-            roofline = roofline_fields(self._cost, wall_s,
+            roofline = roofline_fields(cost, wall_s,
                                        exchanges=ex_step,
                                        exposed_exchanges=exposed_step)
         self.recorder.record_step(
@@ -896,15 +954,25 @@ class FullBatchTrainer:
         )
 
     @staticmethod
-    def _drift_fields(gauges: dict, age: int, sync_step: bool) -> dict:
+    def _drift_fields(gauges: dict, age: int, sync_step: bool,
+                      rr_sizes: tuple | None = None) -> dict:
         """Host-side rendering of the in-graph gauge scalars (see
-        ``_one_step_stale``) into the schema's drift block."""
+        ``_one_step_stale``) into the schema's drift block.
+
+        ``rr_sizes`` (composed stale × ragged mode only): adds the
+        per-round staleness-age vector ``round_age`` — for each ring round,
+        the age of the buffer the step CONSUMED (0 on a sync step: received
+        this step; the staleness age on a stale step: carried from t−1;
+        null for rounds with S_d = 0, which ship nothing).  Uniform today
+        (all rounds share one sync schedule) but per-round by construction,
+        so ``--sync-every`` tuning stays observable if round scheduling
+        ever diverges (``scripts/obs_report.py`` renders it)."""
         import numpy as np
 
         d = np.sqrt(np.maximum(np.asarray(gauges["drift_sq"], np.float64), 0))
         r = np.sqrt(np.maximum(np.asarray(gauges["ref_sq"], np.float64), 0))
         q = np.sqrt(np.maximum(np.asarray(gauges["qerr_sq"], np.float64), 0))
-        return {
+        out = {
             "staleness_age": int(age),
             "sync_step": bool(sync_step),
             "halo_drift_rms": [float(x) for x in d],
@@ -912,6 +980,11 @@ class FullBatchTrainer:
                                for x, y in zip(d, r)],
             "halo_quant_err_rms": [float(x) for x in q],
         }
+        if rr_sizes is not None:
+            out["round_age"] = [None if sd == 0
+                                else (0 if sync_step else int(age))
+                                for sd in rr_sizes]
+        return out
 
     # ------------------------------------------------------------------- api
     def step(self, data: TrainData, sync: bool = True):
@@ -936,7 +1009,11 @@ class FullBatchTrainer:
                 loss = float(loss)
                 self._record_step_event(
                     loss, err, gnorm, time.perf_counter() - t0,
-                    drift=self._drift_fields(gauges, age, sync_step))
+                    drift=self._drift_fields(
+                        gauges, age, sync_step,
+                        rr_sizes=(self.plan.rr_sizes
+                                  if self.comm_schedule == "ragged"
+                                  else None)))
             return float(loss) if sync else loss
         if self.recorder is not None:
             self.params, self.opt_state, loss, err, gnorm = self._step_tel(

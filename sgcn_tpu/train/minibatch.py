@@ -134,9 +134,10 @@ class MiniBatchTrainer:
         # set (the shared rule in parallel/plan.py), then pad every plan's
         # round sizes to the elementwise max
         from ..parallel.plan import resolve_comm_schedule
+        self.comm_decision: dict = {}   # selection inputs → run manifest
         comm_schedule = resolve_comm_schedule(
             comm_schedule, self.plans, model, fin=fin, widths=list(widths),
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, decision=self.comm_decision)
         if comm_schedule == "ragged":
             # EVERY plan needs the layout (the fused sweep stacks the ragged
             # arrays across batches), padded to the shared round envelope;
@@ -172,6 +173,8 @@ class MiniBatchTrainer:
         emits no per-step events — use the stepwise ``fit`` under
         telemetry."""
         self.recorder = recorder
+        if getattr(self, "comm_decision", None):
+            recorder.set_comm_schedule(self.comm_decision)
 
     def _comm_snapshot(self, stats: CommStats) -> dict:
         """O(k) running equivalent of ``CommStats.merged_report`` over every
@@ -233,7 +236,8 @@ class MiniBatchTrainer:
                     # same per-layer wire lane widths as the inner trainer's
                     # counters, so per-batch byte gauges stay comparable
                     lane_widths=self.inner.stats.lane_widths,
-                    wire_itemsize=self.inner.stats.wire_itemsize),
+                    wire_itemsize=self.inner.stats.wire_itemsize,
+                    wire_itemsize_bwd=self.inner.stats.wire_itemsize_bwd),
             ))
         return out
 

@@ -52,12 +52,26 @@ class CommStats:
     # (tests/test_metrics_cli.py, tests/test_gat_ragged.py).  Empty = rows
     # only (pre-PR-5 reports).
     lane_widths: tuple = ()
-    wire_itemsize: int = 4                 # bytes per f32-equivalent lane
+    wire_itemsize: int = 4                 # bytes per f32-equivalent lane,
+    #                                        FORWARD (feature) direction
+    # Gradient-direction wire itemsize (None = same as wire_itemsize): the
+    # halo-delta cache narrows ONLY the feature wire, so a delta run ships
+    # bf16 forward and (by default) f32 backward — one blended number would
+    # misstate both directions (docs/observability.md, per-step split).
+    wire_itemsize_bwd: int | None = None
+    # Cumulative byte gauges with PER-STEP itemsize resolution: a delta
+    # run's sync steps re-base on an f32 feature wire while its stale steps
+    # ship bf16, so the cumulative bytes are accumulated step by step
+    # (count_step's wire_itemsize override) rather than derived per_step ×
+    # steps.  Zero until lane_widths is set.
+    halo_bytes_true_total: int = 0
+    halo_bytes_wire_total: int = 0
 
     @classmethod
     def from_plan(cls, plan, schedule: str = "a2a",
                   lane_widths: tuple = (),
-                  wire_itemsize: int = 4) -> "CommStats":
+                  wire_itemsize: int = 4,
+                  wire_itemsize_bwd: int | None = None) -> "CommStats":
         off = plan.offwire_send_counts()
         send_vol = plan.predicted_send_volume.astype(np.int64)
         send_msg = plan.predicted_message_count.astype(np.int64)
@@ -89,19 +103,45 @@ class CommStats:
             padding_efficiency=(true / wire if wire else 1.0),
             lane_widths=tuple(int(w) for w in lane_widths),
             wire_itemsize=int(wire_itemsize),
+            wire_itemsize_bwd=(None if wire_itemsize_bwd is None
+                               else int(wire_itemsize_bwd)),
         )
 
-    def count_step(self, nlayers: int, hidden: bool = False) -> None:
+    def _accumulate_bytes(self, fwd_sweeps: int, bwd_sweeps: int,
+                          fwd_itemsize: int | None = None) -> None:
+        """Advance the cumulative byte gauges by ``fwd_sweeps`` forward +
+        ``bwd_sweeps`` backward exchange SWEEPS (one sweep = one exchange
+        per layer, at that layer's lane width — ``lane_widths`` already
+        sums over layers), at this step's wire itemsizes (``fwd_itemsize``
+        overrides the forward default — the delta-mode sync step's f32
+        re-base)."""
+        if not self.lane_widths:
+            return
+        fwd = self.wire_itemsize if fwd_itemsize is None else fwd_itemsize
+        bwd = (self.wire_itemsize if self.wire_itemsize_bwd is None
+               else self.wire_itemsize_bwd)
+        lane = sum(self.lane_widths)
+        per_true = int(self.send_volume_per_exchange.sum())
+        factor = lane * (fwd * fwd_sweeps + bwd * bwd_sweeps)
+        self.halo_bytes_true_total += per_true * factor
+        self.halo_bytes_wire_total += self.wire_rows_per_exchange * factor
+
+    def count_step(self, nlayers: int, hidden: bool = False,
+                   wire_itemsize: int | None = None) -> None:
         """One training step = nlayers forward + nlayers backward exchanges
         (the backward halo exchange mirrors the forward —
         ``Parallel-GCN/main.c:340-372``).  ``hidden=True`` marks the step's
-        exchanges as latency-hidden (stale pipelined mode)."""
+        exchanges as latency-hidden (stale pipelined mode).
+        ``wire_itemsize`` overrides this step's FORWARD wire itemsize in
+        the cumulative byte gauges (the delta cache's f32 re-base syncs)."""
         self.exchanges += 2 * nlayers
         if hidden:
             self.hidden_exchanges += 2 * nlayers
+        self._accumulate_bytes(1, 1, fwd_itemsize=wire_itemsize)
 
     def count_forward(self, nlayers: int) -> None:
         self.exchanges += nlayers
+        self._accumulate_bytes(1, 0)
 
     def cumulative(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Per-rank cumulative (send_vol, send_msgs, recv_vol, recv_msgs)."""
@@ -154,13 +194,21 @@ class CommStats:
         )
         if self.lane_widths:
             # lane-weighted byte gauges: one fwd + one bwd exchange per
-            # layer per step, each at that layer's true wire width — the
-            # CommStats side of the attribution reconciliation contract
-            lane_b = 2 * sum(self.lane_widths) * self.wire_itemsize
+            # layer per step, each at that layer's true wire width and its
+            # DIRECTION's itemsize — the CommStats side of the attribution
+            # reconciliation contract.  The *_per_step keys describe the
+            # steady-state (stale/default) step; the *_total keys are
+            # cumulative with per-step itemsize resolution (delta-mode sync
+            # steps book their f32 re-base wire at 4 bytes).
+            bwd = (self.wire_itemsize if self.wire_itemsize_bwd is None
+                   else self.wire_itemsize_bwd)
+            lane_b = sum(self.lane_widths) * (self.wire_itemsize + bwd)
             rep.update(
                 halo_bytes_true_per_step=per_ex * lane_b,
                 halo_bytes_wire_per_step=self.wire_rows_per_exchange
                 * lane_b,
+                halo_bytes_true_total=self.halo_bytes_true_total,
+                halo_bytes_wire_total=self.halo_bytes_wire_total,
             )
         return rep
 
@@ -203,4 +251,13 @@ class CommStats:
             padding_efficiency=(rep["total_send_volume"] / wire_total
                                 if wire_total else 1.0),
         )
+        if any(s.lane_widths for s in stats_list):
+            # cumulative byte gauges sum per counter (each counter's lane
+            # widths and per-step itemsizes are its own plan's/config's)
+            rep.update(
+                halo_bytes_true_total=sum(
+                    s.halo_bytes_true_total for s in stats_list),
+                halo_bytes_wire_total=sum(
+                    s.halo_bytes_wire_total for s in stats_list),
+            )
         return rep

@@ -20,7 +20,8 @@ from sgcn_tpu.models.gcn import (GCN_PLAN_FIELDS_GEN, GCN_PLAN_FIELDS_RAGGED,
 from sgcn_tpu.ops.pallas_spmm import PALLAS_PLAN_FIELDS
 from sgcn_tpu.parallel import build_comm_plan
 from sgcn_tpu.parallel.plan import (_GLOBAL_ARRAY_FIELDS,
-                                    PER_CHIP_ARRAY_FIELDS, CommPlan)
+                                    PER_CHIP_ARRAY_FIELDS,
+                                    STALE_PLAN_FIELDS_RAGGED, CommPlan)
 from sgcn_tpu.partition import balanced_random_partition
 from sgcn_tpu.prep import normalize_adjacency
 
@@ -34,6 +35,7 @@ CONSUMER_TUPLES = {
     "GCN_PLAN_FIELDS_SYM": GCN_PLAN_FIELDS_SYM,
     "GCN_PLAN_FIELDS_GEN": GCN_PLAN_FIELDS_GEN,
     "GCN_PLAN_FIELDS_RAGGED": GCN_PLAN_FIELDS_RAGGED,
+    "STALE_PLAN_FIELDS_RAGGED": STALE_PLAN_FIELDS_RAGGED,
 }
 
 
@@ -92,7 +94,7 @@ def test_shipped_field_tuples_are_sliceable():
     for tup_name in ("PALLAS_PLAN_FIELDS", "GAT_PLAN_FIELDS",
                      "GAT_PLAN_FIELDS_RAGGED",
                      "GCN_PLAN_FIELDS_SYM", "GCN_PLAN_FIELDS_GEN",
-                     "GCN_PLAN_FIELDS_RAGGED"):
+                     "GCN_PLAN_FIELDS_RAGGED", "STALE_PLAN_FIELDS_RAGGED"):
         for f in CONSUMER_TUPLES[tup_name]:
             v = getattr(plan, f)
             assert isinstance(v, np.ndarray), (
@@ -120,3 +122,9 @@ def test_ragged_fields_covered_on_day_one():
     # new dataclass fields, but the consumer tuple is covered day one
     assert set(GAT_PLAN_FIELDS_RAGGED) <= set(PER_CHIP_ARRAY_FIELDS)
     assert {"rsend_idx", "rhalo_dst"} <= set(GAT_PLAN_FIELDS_RAGGED)
+    # the PR-6 composed stale × ragged tuple too: same ring arrays (the
+    # round-structured carries replace send_idx/halo_src — receives live
+    # in the carry, the fold rides redge_*), covered day one
+    assert set(STALE_PLAN_FIELDS_RAGGED) <= set(PER_CHIP_ARRAY_FIELDS)
+    assert {"rsend_idx", "redge_dst"} <= set(STALE_PLAN_FIELDS_RAGGED)
+    assert not {"send_idx", "halo_src"} & set(STALE_PLAN_FIELDS_RAGGED)

@@ -12,8 +12,9 @@ Contract pinned here (docs/comm_schedule.md):
     strictly below the dense k²·S whenever the partition is skewed;
   * the shard proxy runs the ragged program on one device under the same
     optimization_barrier fidelity contract as the dense exchange;
-  * composition with the stale pipelined exchange is DEFERRED — a clean
-    construction-time error, never a silently-wrong wire.
+  * composition with the stale pipelined exchange is SUPPORTED since the
+    round-structured carry (``pspmm_stale_ragged``) — its parity and gauge
+    coverage lives in tests/test_stale_ragged.py.
 """
 
 import os
@@ -308,23 +309,28 @@ def test_ensure_ragged_needs_full_plan(skewplan):
 
 
 def test_gating(asymplan, cora):
-    """Invalid combinations fail loudly at construction: stale composition
-    (deferred), asymmetric plans, unknown values.  GAT + ragged is a
-    SUPPORTED contract since the multi-lane ring (tests/test_gat_ragged.py
-    owns its parity coverage)."""
+    """Invalid combinations fail loudly at construction: asymmetric plans,
+    unknown values.  GAT + ragged is a SUPPORTED contract since the
+    multi-lane ring (tests/test_gat_ragged.py owns its parity coverage),
+    and ragged + staleness is the SUPPORTED composed mode since the
+    round-structured carry (tests/test_stale_ragged.py owns its parity
+    coverage)."""
     plan, *_ = cora
-    with pytest.raises(ValueError, match="does not compose with"):
-        FullBatchTrainer(plan, fin=8, widths=[8, 7], halo_staleness=1,
-                         comm_schedule="ragged")
+    tr_comp = FullBatchTrainer(plan, fin=8, widths=[8, 7], halo_staleness=1,
+                               comm_schedule="ragged")
+    assert tr_comp.comm_schedule == "ragged" and tr_comp.halo_staleness == 1
     tr_gat = FullBatchTrainer(plan, fin=8, widths=[8, 7], model="gat",
                               comm_schedule="ragged")
     assert tr_gat.comm_schedule == "ragged"
     with pytest.raises(ValueError, match="a2a"):
         FullBatchTrainer(plan, fin=8, widths=[8, 7], comm_schedule="bogus")
-    # stale + auto silently keeps the a2a wire (auto is a preference)
+    # stale + auto resolves by the wire-byte-only rule (the hidden exchange
+    # makes the latency threshold moot), which picks ragged whenever the
+    # ring ships fewer wire rows — true on any supported k>1 plan
     tr = FullBatchTrainer(plan, fin=8, widths=[8, 7], halo_staleness=1,
                           comm_schedule="auto")
-    assert tr.comm_schedule == "a2a"
+    assert tr.comm_schedule == "ragged"
+    assert "wire-byte rule" in tr.comm_decision["rule"]
 
     import dataclasses
     aplan = dataclasses.replace(asymplan[0], symmetric=False)

@@ -16,7 +16,7 @@ sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from validate_bench import (check_bench_record, check_multichip_record,  # noqa: E402
                             check_products_ksweep, check_ragged_ab,
-                            validate_tree)
+                            check_ragged_stale_ab, validate_tree)
 
 
 def test_checked_in_artifacts_validate():
@@ -89,6 +89,53 @@ def test_validator_ragged_ab_contract():
     assert any("padding_efficiency" in e for e in check_ragged_ab(bad_pe))
     assert any("no random/hp" in e
                for e in check_ragged_ab({"ragged_ab_8dev": {}}))
+
+
+def _rsab_arm(frac, wire, nl=2, **over):
+    a = {"epoch_s": 0.03, "wire_rows_per_exchange": wire,
+         "exposed_comm_frac": frac,
+         "exposed_wire_rows_per_step": round(frac * wire * 2 * nl, 2)}
+    a.update(over)
+    return a
+
+
+def _rsab_block(**over):
+    b = {"arms": {"a2a_stale": _rsab_arm(0.25, 1000),
+                  "ragged_exact": _rsab_arm(1.0, 600),
+                  "ragged_stale": _rsab_arm(0.25, 600)},
+         "clean_pairs": 3,
+         "note": "epoch speed is not the asserted figure — exposed-comm "
+                 "accounting is"}
+    b.update(over)
+    return b
+
+
+def test_validator_ragged_stale_ab_contract():
+    """The composed-mode three-way block (PR-6): null needs a degradation
+    marker; the composed arm must be <= both single levers on the exposed
+    fraction and STRICTLY below both on exposed wire rows per step, and
+    the honest-measurement note must be present."""
+    assert any("ragged_stale_ab_degraded" in e for e in check_ragged_stale_ab(
+        {"ragged_stale_ab_8dev": None}))
+    assert not check_ragged_stale_ab(
+        {"ragged_stale_ab_8dev": None, "ragged_stale_ab_degraded": "deadline"})
+    assert not check_ragged_stale_ab({"ragged_stale_ab_8dev": _rsab_block()})
+    # composed fraction above a single lever's — acceptance violated
+    bad_frac = _rsab_block()
+    bad_frac["arms"]["ragged_stale"] = _rsab_arm(0.5, 600)
+    errs = check_ragged_stale_ab({"ragged_stale_ab_8dev": bad_frac})
+    assert any("exposed_comm_frac" in e and "acceptance" in e for e in errs)
+    # composed exposed wire rows not strictly below a2a+stale (same wire)
+    bad_wire = _rsab_block()
+    bad_wire["arms"]["ragged_stale"] = _rsab_arm(0.25, 1000)
+    errs = check_ragged_stale_ab({"ragged_stale_ab_8dev": bad_wire})
+    assert any("STRICTLY" in e for e in errs)
+    # the honest-measurement note is part of the contract
+    no_note = _rsab_block(note="timings")
+    assert any("note" in e for e in check_ragged_stale_ab(
+        {"ragged_stale_ab_8dev": no_note}))
+    assert any("missing arm" in e for e in check_ragged_stale_ab(
+        {"ragged_stale_ab_8dev": {"arms": {"a2a_stale": _rsab_arm(1, 10)}}}))
 
 
 def test_validator_rejects_unresolved_comm_schedule():
