@@ -609,8 +609,14 @@ def bench_stale_ab_child(ahat, feats, labels, widths, epochs: int,
             return run
         return make_run
 
-    exact_s, stale_s, clean = paired_differential(
-        arm(), arm(halo_staleness=1), max(8, epochs), what="stale A/B")
+    # arm-level measured span (never per-step: instrumentation inside the
+    # timed differential loop would perturb the measurement itself) — lands
+    # in the parent bench's run dir through the inherited $SGCN_METRICS_OUT
+    from sgcn_tpu.obs.tracing import scoped_span
+    with scoped_span("bench:stale_ab", phase="ab_child",
+                     detail=f"n={n} graph={graph}"):
+        exact_s, stale_s, clean = paired_differential(
+            arm(), arm(halo_staleness=1), max(8, epochs), what="stale A/B")
     return {
         "epoch_s_exact": round(exact_s, 6),
         "epoch_s_stale1": round(stale_s, 6),
@@ -717,9 +723,13 @@ def bench_ragged_ab_child(ahat, feats, labels, widths, epochs: int,
                 return run
             return make_run
 
-        a2a_s, rag_s, clean = paired_differential(
-            arm("a2a"), arm("ragged"), nep,
-            what=f"{model} ragged A/B ({name})")
+        # arm-level span (see bench_stale_ab_child: never inside the loop)
+        from sgcn_tpu.obs.tracing import scoped_span
+        with scoped_span(f"bench:{model}_ragged_ab:{name}",
+                         phase="ab_child", detail=f"n={n} graph={graph}"):
+            a2a_s, rag_s, clean = paired_differential(
+                arm("a2a"), arm("ragged"), nep,
+                what=f"{model} ragged A/B ({name})")
         true = int(plan.predicted_send_volume.sum())
         wire_a2a = plan.wire_rows_per_exchange("a2a")
         wire_rag = plan.wire_rows_per_exchange("ragged")
@@ -837,9 +847,13 @@ def bench_ragged_stale_ab_child(ahat, feats, labels, widths, epochs: int,
         return make_run
 
     names = list(trainers)
-    times, clean = paired_differential_multi(
-        [make(trainers[nm]) for nm in names], max(6, epochs),
-        what="ragged-stale A/B")
+    # arm-level span (see bench_stale_ab_child: never inside the loop)
+    from sgcn_tpu.obs.tracing import scoped_span
+    with scoped_span("bench:ragged_stale_ab", phase="ab_child",
+                     detail=f"n={n} graph={graph}"):
+        times, clean = paired_differential_multi(
+            [make(trainers[nm]) for nm in names], max(6, epochs),
+            what="ragged-stale A/B")
     nl = len(widths)
     arms: dict = {}
     for nm, t in zip(names, times):
@@ -1167,6 +1181,12 @@ def main() -> None:
                    help=argparse.SUPPRESS)
     args = p.parse_args()
 
+    if args.metrics_out:
+        # measured spans from THIS process and every A/B child land in the
+        # run directory's event stream (obs.tracing.emit_span is env-gated,
+        # exactly like heartbeats; children inherit the env)
+        os.environ["SGCN_METRICS_OUT"] = os.path.abspath(args.metrics_out)
+
     # --comm-schedule ragged + --halo-staleness 1 is the supported COMPOSED
     # mode (pspmm_stale_ragged) — the flagship can bench it directly
     if (args.halo_delta or args.sync_every) and not args.halo_staleness:
@@ -1239,6 +1259,10 @@ def main() -> None:
             "value": round(mb_s, 6),
             "unit": "s",
             "graph": args.graph,
+            # provenance: this number came out of a live differential
+            # measurement in THIS process — scripts/validate_bench.py
+            # requires the flag on every epoch-time claim from round 6 on
+            "measured": True,
             "measurement": dict(_diff_time_quality),
             **mb_metrics,
         }, args)
@@ -1256,8 +1280,11 @@ def main() -> None:
         "metric": f"fullbatch_{args.model}_epoch_time",
         "value": None, "unit": "s", "graph": args.graph,
     }
+    from sgcn_tpu.obs.tracing import scoped_span
     try:
-        with _phase_deadline(deadline, "flagship"):
+        with _phase_deadline(deadline, "flagship"), \
+                scoped_span("bench:flagship", phase="flagship",
+                            detail=f"{args.model} n={args.n}"):
             epoch_s, part_metrics = bench_jax(
                 ahat, feats, labels, widths, args.epochs,
                 model=args.model, dtype=args.dtype, remat=args.remat,
@@ -1359,6 +1386,10 @@ def main() -> None:
         "value": round(epoch_s, 6),
         "unit": "s",
         "graph": args.graph,
+        # provenance: a live differential measurement from THIS process
+        # (degraded/skipped partials carry a marker instead of the flag) —
+        # scripts/validate_bench.py enforces it from round 6 on
+        "measured": True,
         "vs_baseline": vs,
         "vs_torch_cpu": vs,
         # ADVICE r3: label the yardstick — vs_baseline is measured against

@@ -1,0 +1,251 @@
+"""Trend report + regression gate over the ``BENCH_r*.json`` history.
+
+Usage::
+
+    python scripts/bench_trend.py [ROOT] [--check] [--time-band X]
+
+Every round's bench driver record is already schema-checked individually
+(``scripts/validate_bench.py``); this script is the TREND contract on top:
+the per-round numbers form series, and ``--check`` fails when the newest
+point of a series regresses outside its tolerance band.  Run in tier-1 by
+``tests/test_bench_trend.py``, so a landed bench regression fails CI
+instead of silently becoming the new baseline.
+
+Rules:
+
+  * **Series identity** — points are only compared when they measure the
+    same thing: the flagship/minibatch epoch time keys on
+    ``(metric, graph, unit)`` plus any scalar bench-config fields the
+    record carries (``_TIME_CFG_KEYS``: problem size, model, dtype, …;
+    a ``partitioner`` of ``"none"`` normalizes to absent); the 8-dev
+    diagnostic gauges additionally key on their own config (``n_8dev``,
+    ``graph_8dev``, ``partitioner_8dev``).  A config change starts a new
+    series rather than faking a regression.
+  * **Tolerance bands, per metric kind** — measured wall-clock values
+    (``unit == "s"``; other units form report-only series, since a
+    throughput-style metric improves UPWARD and must not trip a
+    lower-is-better band) get a MULTIPLICATIVE band (default ``--time-band
+    2.0``: the newest point must be ≤ 2× the MEDIAN previous point).  The
+    anchor is the median, not the historical best — one lucky fast outlier
+    must not permanently tighten the gate — and the band sits above this
+    host's measured cross-session drift (BASELINE.md: identical code
+    2.18 s vs 3.63 s across sessions = 1.665×), so only a regression on
+    top of normal drift trips it.  Deterministic counters
+    (``COUNTER_KEYS``: ``km1_8dev``, ``comm_volume_rows_8dev``) get a ZERO
+    band: they are plan-derived, reproducible bit-for-bit, and may never
+    increase within a series.
+  * **Degradation-marker aware** — a record with ``rc != 0``, or a null
+    ``value`` carrying a ``skipped``/``degraded`` marker, is a GAP in the
+    series (reported, never compared): the graceful-degradation contract
+    says a missing number explains itself, and a gap must not poison the
+    trend either way.
+
+Exit status: 0 clean (or report-only mode), 1 with violations listed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import numbers
+import os
+import re
+import sys
+from collections import defaultdict
+
+ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# deterministic (plan-derived) gauges: zero tolerance, may never increase
+COUNTER_KEYS = ("km1_8dev", "comm_volume_rows_8dev")
+# flagship keys that scope a counter series to one diagnostic config
+_DIAG_CFG_KEYS = ("n_8dev", "graph_8dev", "partitioner_8dev")
+# scalar bench-config fields that scope a wall-clock series: a round run at
+# a different problem size / model / dtype is a DIFFERENT measurement, not
+# a regression (graph already keys separately)
+_TIME_CFG_KEYS = ("n", "model", "dtype", "layers", "epochs", "partitioner")
+
+DEFAULT_TIME_BAND = 2.0
+
+
+def _median(vals: list) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def _is_num(x) -> bool:
+    # non-finite floats must not enter a series: every NaN comparison is
+    # False, so one NaN value (or a NaN-poisoned median anchor) would make
+    # the gate read clean forever (validate_bench rejects NaN at the file
+    # level; this guards the gate when run standalone)
+    return (isinstance(x, numbers.Real) and not isinstance(x, bool)
+            and math.isfinite(x))
+
+
+def load_history(root: str) -> list:
+    """``[(round, filename, record)]`` sorted by round number."""
+    out = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        with open(path) as fh:
+            out.append((int(m.group(1)), os.path.basename(path),
+                        json.load(fh)))
+    return sorted(out)
+
+
+def extract_series(history) -> tuple[dict, list]:
+    """Split the history into comparable series and gaps.
+
+    Returns ``(series, gaps)``: ``series`` maps a key tuple to
+    ``[(round, value)]`` in round order; ``gaps`` is ``[(round, reason)]``
+    for rounds that measured nothing (degradation-marker aware)."""
+    series: dict = defaultdict(list)
+    gaps: list = []
+    for rnd, fname, rec in history:
+        if rec.get("rc") != 0:
+            gaps.append((rnd, f"rc={rec.get('rc')} "
+                              f"(tail: {str(rec.get('tail'))[-60:].strip()})"))
+            continue
+        parsed = rec.get("parsed")
+        if not isinstance(parsed, dict):
+            gaps.append((rnd, "no parsed result"))
+            continue
+        v = parsed.get("value")
+        metric = parsed.get("metric", "?")
+        if v is None:
+            reason = (parsed.get("degraded") or parsed.get("skipped")
+                      or "value null")
+            gaps.append((rnd, f"{metric}: {reason}"))
+            continue    # a degraded round is a GAP for its counters too —
+            #             a partial diagnostic must not enter the zero-band
+            #             series either
+        elif _is_num(v):
+            # only wall-clock values (unit "s", lower-is-better by
+            # construction) are gate-able; other units form report-only
+            # series — a throughput metric improving upward must not trip
+            # the band
+            unit = parsed.get("unit", "s")
+            kind = "time" if unit == "s" else "metric"
+            cfg = tuple(None if (c := parsed.get(k)) == "none" else c
+                        for k in _TIME_CFG_KEYS)
+            key = (kind, metric, parsed.get("graph", "er"), unit) + cfg
+            series[key].append((rnd, float(v)))
+        # deterministic 8-dev diagnostic counters, scoped to their config
+        cfg = tuple(parsed.get(k) for k in _DIAG_CFG_KEYS)
+        if any(c is not None for c in cfg):
+            for ck in COUNTER_KEYS:
+                if _is_num(parsed.get(ck)):
+                    series[("counter", ck) + cfg].append(
+                        (rnd, float(parsed[ck])))
+    return dict(series), gaps
+
+
+def check_series(series: dict, time_band: float = DEFAULT_TIME_BAND) -> list:
+    """Gate the newest point of every multi-point series against its band;
+    returns violation strings (empty = clean)."""
+    problems = []
+    # cfg slots mix None/str/int — sort on the stringified key
+    for key, pts in sorted(series.items(),
+                           key=lambda kv: tuple(map(str, kv[0]))):
+        if len(pts) < 2:
+            continue
+        prev, (last_rnd, last) = pts[:-1], pts[-1]
+        best = min(v for _, v in prev)
+        kind = key[0]
+        if kind == "metric":
+            continue        # non-"s" units: reported, never gated (no
+            #                 universal better-direction for them)
+        if kind == "time":
+            # median anchor: a single lucky fast point must not tighten
+            # the gate forever, and the band must clear this host's
+            # documented 1.665x cross-session drift (BASELINE.md)
+            anchor = _median([v for _, v in prev])
+            limit = anchor * time_band
+            if last > limit:
+                problems.append(
+                    f"{_key_name(key)}: r{last_rnd:02d} value {last:g} "
+                    f"exceeds the {time_band}x band over the median "
+                    f"previous point {anchor:g} (limit {limit:g}) — a "
+                    "measured-time regression landed in the bench history")
+        else:
+            if last > best:
+                problems.append(
+                    f"{_key_name(key)}: r{last_rnd:02d} value {last:g} "
+                    f"above the best previous {best:g} — deterministic "
+                    "plan-derived counters may never regress within one "
+                    "config")
+    return problems
+
+
+def _key_name(key: tuple) -> str:
+    if key[0] in ("time", "metric"):
+        cfg = [f"{k}={c}" for k, c in zip(_TIME_CFG_KEYS, key[4:])
+               if c is not None]
+        return f"{key[1]} (graph={key[2]}, {key[3]}" \
+               + (", " + ", ".join(cfg) if cfg else "") + ")"
+    return f"{key[1]} ({', '.join(str(c) for c in key[2:] if c is not None)})"
+
+
+def render(series: dict, gaps: list, problems: list) -> str:
+    lines = ["bench trend:"]
+    for key, pts in sorted(series.items(),
+                           key=lambda kv: tuple(map(str, kv[0]))):
+        trail = "  ".join(f"r{r:02d}={v:g}" for r, v in pts)
+        lines.append(f"  {_key_name(key)}: {trail}")
+        if len(pts) >= 2:
+            first, last = pts[0][1], pts[-1][1]
+            if first > 0:
+                # report-only series (kind "metric") have no universal
+                # better-direction — label the trend neutrally
+                word = ("change" if key[0] == "metric"
+                        else "improvement" if last <= first
+                        else "regression")
+                lines.append(f"    net {word}: "
+                             f"{first:g} -> {last:g} ({last / first:.3g}x)")
+    if gaps:
+        lines.append("  gaps (degraded/skipped rounds, never compared):")
+        for rnd, reason in gaps:
+            lines.append(f"    r{rnd:02d}: {reason}")
+    if problems:
+        lines.append(f"  VIOLATIONS ({len(problems)}):")
+        for p in problems:
+            lines.append(f"    {p}")
+    else:
+        lines.append("  gate: clean")
+    return "\n".join(lines)
+
+
+def check_tree(root: str, time_band: float = DEFAULT_TIME_BAND):
+    """Full pipeline for one root: ``(problems, report_text)``."""
+    series, gaps = extract_series(load_history(root))
+    problems = check_series(series, time_band=time_band)
+    return problems, render(series, gaps, problems)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", nargs="?",
+                    default=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    help="directory holding the BENCH_r*.json history")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on tolerance-band violations "
+                         "(the tier-1 gate mode)")
+    ap.add_argument("--time-band", type=float, default=DEFAULT_TIME_BAND,
+                    help="multiplicative band for measured wall-clock "
+                         "series (newest <= band x median previous); "
+                         f"default {DEFAULT_TIME_BAND}")
+    args = ap.parse_args()
+    problems, report = check_tree(args.root, time_band=args.time_band)
+    print(report)
+    if args.check and problems:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

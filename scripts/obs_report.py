@@ -13,6 +13,13 @@ Loads (and schema-validates) the directory written by ``--metrics-out``
     utilization, the hidden-vs-exposed comm split, and — for stale-halo
     runs — the drift-gauge columns (staleness age, per-layer drift,
     quantization error);
+  * the measured-time layer (schema v2, ``sgcn_tpu/obs/tracing.py``):
+    span breakdown (per-name count/total, nesting), the per-step
+    ``measured_vs_model`` reconciliation (ratio + absolute error per
+    component), and — when the manifest records a ``--profile`` trace —
+    the trace-derived attribution: per-class op seconds, measured overlap
+    fraction / exposed-comm time, per-device straggler skew, joined
+    against the analytic exposed-comm fraction;
   * eval records, summary report, and the heartbeat timeline (the
     "slow vs stalled" signal of the launch/dryrun layers).
 
@@ -55,8 +62,9 @@ def render(path: str, max_steps: int = 12) -> str:
         lines.append(f"  kind={m['run_kind']}  schema=v{m['v']}  "
                      f"git={(m.get('git_rev') or '?')[:10]}")
     else:
-        lines.append("  (heartbeats only — no manifest; the launch/dryrun "
-                     "layers ping without a RunRecorder)")
+        lines.append("  (no manifest — heartbeats/spans written through "
+                     "$SGCN_METRICS_OUT without a RunRecorder, e.g. the "
+                     "launch/dryrun layers or a killed bench)")
     be = m.get("backend")
     if be:
         lines.append(f"  backend: {be.get('platform')} × "
@@ -168,6 +176,99 @@ def render(path: str, max_steps: int = 12) -> str:
                 f"{_fmt(r.get('exposed_comm_frac'), 3):>8} "
                 f"{_fmt(d.get('staleness_age')):>4} "
                 f"{_fmt((d.get('halo_drift_rms') or [None])[-1], 4):>10}")
+
+    # ---------------------------------------------- measured-time layer (v2)
+    spans = [e for e in log.events if e["kind"] == "span"]
+    if spans:
+        lines.append(f"\nspans: {len(spans)}")
+        by_name: dict = {}
+        for sp in spans:
+            agg = by_name.setdefault(sp["name"], [0, 0.0, 0])
+            agg[0] += 1
+            agg[1] += sp["dur_s"]
+            agg[2] = max(agg[2], int(sp.get("depth", 0)))
+        for name, (cnt, tot, depth) in sorted(by_name.items(),
+                                              key=lambda kv: -kv[1][1]):
+            lines.append(f"  {name}: n={cnt} total {_fmt(tot)}s "
+                         f"avg {_fmt(tot / cnt)}s"
+                         + (f" (max depth {depth})" if depth else ""))
+    if steps:
+        mvms = [s["measured_vs_model"] for s in steps
+                if isinstance(s.get("measured_vs_model"), dict)]
+        if mvms:
+            lines.append("\nmeasured vs model (per-step reconciliation):")
+            lines.append("  phase total: "
+                         + _stats([m["phase_total_s"] for m in mvms]) + " s")
+            for comp in mvms[-1]["components"]:
+                ratios = [m["components"][comp]["ratio"] for m in mvms
+                          if m["components"].get(comp, {}).get("ratio")
+                          is not None]
+                last = mvms[-1]["components"][comp]
+                lines.append(
+                    f"  {comp}: model {_fmt(last.get('model_s'))}s, "
+                    f"measured {_fmt(last.get('measured_s'))}s (last)"
+                    + (f"; ratio {_stats(ratios)}" if ratios else ""))
+    # even a manifest-less dir (killed bench) resolves a trace copied under
+    # the run dir — trace_path_for_run's last-resort rundir glob
+    from sgcn_tpu.obs.tracing import summarize_trace, trace_path_for_run
+    tpath = trace_path_for_run(m or {}, path)
+    if tpath:
+        try:
+            ts = summarize_trace(tpath)
+        except (OSError, ValueError, KeyError) as e:
+            lines.append(f"\ntrace: {tpath} failed to parse: {e}")
+            ts = None
+        if ts is not None:
+            lines.append(f"\ntrace ({os.path.basename(tpath)}, "
+                         f"{ts.n_events} classified ops):")
+            lines.append("  measured op classes: " + "  ".join(
+                f"{c}={_fmt(ts.classes.get(c, 0.0))}s"
+                for c in ("spmm", "dense", "exchange", "collective_wait",
+                          "other")))
+            roofs = [s["roofline"] for s in steps if s.get("roofline")]
+            ef = [r["exposed_comm_frac"] for r in roofs
+                  if "exposed_comm_frac" in r]
+            if ts.measured_overlap_frac is not None:
+                lines.append(
+                    f"  comm: {_fmt(ts.comm_s)}s wall, "
+                    f"{_fmt(ts.exposed_comm_s)}s exposed — measured "
+                    f"overlap frac {_fmt(ts.measured_overlap_frac, 3)}")
+                if ef:
+                    lines.append(
+                        "  vs analytic exposed-comm frac "
+                        f"{_fmt(sum(ef) / len(ef), 3)} (event-stream mean) "
+                        "— the measured-vs-model overlap join")
+            if steps:
+                per = ts.per_step(len(steps))
+                lines.append("  per step (/" + str(len(steps)) + "): "
+                             + "  ".join(
+                                 f"{k}={_fmt(v)}s"
+                                 for k, v in per.items() if v))
+                # the exchange component of measured_vs_model, joined
+                # post-hoc (the trace only exists after the run): the ONE
+                # join implementation lives in tracing.exchange_join
+                from sgcn_tpu.obs.tracing import exchange_join
+                ehb = [r["exposed_halo_bytes"] for r in roofs
+                       if "exposed_halo_bytes" in r]
+                if ehb:
+                    j = exchange_join(per, sum(ehb) / len(ehb))
+                    line = (f"  exchange join: model {_fmt(j['model_s'])}s "
+                            f"vs measured {_fmt(j['measured_s'])}s per step")
+                    if "ratio" in j:
+                        line += f" (ratio {_fmt(j['ratio'], 3)})"
+                    nevals = len(log.evals())
+                    if nevals:
+                        # eval forward passes share the profiled region but
+                        # are not steps — their collectives inflate the
+                        # measured side, so it is an upper bound here
+                        line += (f" [{nevals} evals in trace — measured is "
+                                 "an upper bound]")
+                    lines.append(line)
+            if ts.skew:
+                lines.append(
+                    f"  straggler: {ts.skew['straggler']} at "
+                    f"{_fmt(ts.skew['busy_max_over_mean'], 4)}x mean busy "
+                    "(per-device skew gauge)")
 
     for ev in log.evals():
         lines.append(f"\neval @ step {ev['step']}: loss {_fmt(ev['loss'])}"

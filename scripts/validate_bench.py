@@ -41,6 +41,14 @@ What counts as a violation:
     both on exposed wire rows per step, plus the honest-measurement note
     (CPU-mesh epoch speed is never the asserted figure), or be ``null``
     with a degradation marker;
+  * **measured-time provenance** (PR-7): an epoch-time claim (a numeric
+    ``value`` on a ``*_epoch_time`` metric) must carry ``measured: true``
+    — the flag ``bench.py`` sets only when the number came out of a live
+    differential measurement in that process — or a ``skipped``/
+    ``degraded`` marker.  Enforced from round ``BENCH_r06`` on (the first round generated after the flag landed; earlier
+    records predate the flag and retro-stamping provenance onto history
+    would itself be a hand-edit); a ``measured`` flag that is present but
+    not literally ``true`` is a violation at ANY round;
   * **the pow2-k RB constraint** (``products_ksweep.json``): ``hp_rb``
     entries at non-power-of-two k, or k < 32.  The PR-2 review incident:
     ``partition_hypergraph_rb`` recurses on k/2 and the auto-select
@@ -57,7 +65,10 @@ import glob
 import json
 import numbers
 import os
+import re
 import sys
+
+_BENCH_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
 
 def _load_strict(path: str):
@@ -72,6 +83,46 @@ def _load_strict(path: str):
 
 def _is_num(x) -> bool:
     return isinstance(x, numbers.Real) and not isinstance(x, bool)
+
+
+# first bench round whose driver record must carry epoch-time provenance
+# (bench.py emits ``measured: true`` since PR-7; earlier history predates
+# the flag, and stamping it onto old records would itself be a hand-edit)
+MEASURED_PROVENANCE_SINCE = 6
+
+
+def check_measured_provenance(rec: dict, round_no: int | None) -> list[str]:
+    """The epoch-time provenance rule (module docstring): numeric
+    ``*_epoch_time`` values need ``measured: true`` from round
+    ``MEASURED_PROVENANCE_SINCE`` on; a present-but-untrue flag is always
+    a violation (asserting anything but a live measurement is a lie)."""
+    if not isinstance(rec.get("parsed"), dict):
+        return []
+    parsed = rec["parsed"]
+    errs = []
+    # flag integrity applies to ANY record carrying the flag — including a
+    # failed round (rc != 0): a hand-edited false/yes flag is a lie there
+    # too, so only the numeric-claim rule below is rc-gated
+    if "measured" in parsed and parsed["measured"] is not True:
+        errs.append(f"measured={parsed['measured']!r}: the provenance flag "
+                    "may only assert a live measurement (true) — drop it "
+                    "or fix the generator")
+    if rec.get("rc") != 0:
+        return errs
+    metric = parsed.get("metric")
+    if (isinstance(metric, str) and metric.endswith("_epoch_time")
+            and _is_num(parsed.get("value"))
+            and parsed.get("measured") is not True
+            and not (isinstance(parsed.get("skipped"), str)
+                     or isinstance(parsed.get("degraded"), str))
+            and (round_no is None
+                 or round_no >= MEASURED_PROVENANCE_SINCE)):
+        errs.append(f"numeric {metric} value without measured:true "
+                    "provenance (or a skipped/degraded marker) — an "
+                    "epoch-time claim must say it was measured live "
+                    "(bench.py sets the flag; rounds < "
+                    f"r{MEASURED_PROVENANCE_SINCE:02d} are grandfathered)")
+    return errs
 
 
 def check_bench_record(rec: dict) -> list[str]:
@@ -357,7 +408,11 @@ def validate_tree(root: str) -> list[str]:
             problems.append(f"{os.path.relpath(path, root)}: {msg}")
 
     for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json"))):
-        run(path, check_bench_record)
+        m = _BENCH_ROUND_RE.search(os.path.basename(path))
+        rnd = int(m.group(1)) if m else None
+        run(path, lambda rec, rnd=rnd: (check_bench_record(rec)
+                                        + check_measured_provenance(rec,
+                                                                    rnd)))
     for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_*.json"))):
         run(path, check_multichip_record)
     for path in sorted(glob.glob(os.path.join(root, "bench_artifacts",

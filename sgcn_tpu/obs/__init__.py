@@ -1,13 +1,19 @@
 """Run-telemetry subsystem: manifest + per-step JSONL events + attribution.
 
-Three layers (see ``docs/observability.md`` for the operator guide):
+Four layers (see ``docs/observability.md`` for the operator guide):
 
   * ``recorder``    — ``RunRecorder`` (manifest, append-only event stream,
     heartbeats) and the ``load_run`` loader;
   * ``attribution`` — the analytic step cost model (plan-derived SpMM/dense
     FLOPs, gather bytes, halo wire bytes) joined against measured step time
     into roofline fields;
-  * ``schema``      — the versioned event vocabulary both of the above are
+  * ``tracing``     — the MEASURED-time profiling layer: the span API
+    (nested wall-clock spans emitted as ``span`` events), the
+    ``jax.profiler`` trace parser (per-device op timelines classified into
+    the attribution vocabulary → measured overlap / exposed comm /
+    straggler skew), and the per-step ``measured_vs_model`` reconciliation
+    of the two;
+  * ``schema``      — the versioned event vocabulary all of the above are
     validated against.
 
 Wired through the trainers (``FullBatchTrainer.attach_recorder`` /
@@ -20,10 +26,15 @@ from .attribution import (STREAM_CEILING_GBS, StepCostModel,
                           gather_bytes_per_epoch, roofline_fields, step_cost)
 from .recorder import RunLog, RunRecorder, heartbeat, load_run, plan_digest
 from .schema import SCHEMA_VERSION, validate_event, validate_manifest
+from .tracing import (SpanTimer, TraceSummary, classify_op, emit_span,
+                      find_trace_files, measured_vs_model_block, scoped_span,
+                      summarize_trace, trace_path_for_run)
 
 __all__ = [
     "SCHEMA_VERSION", "STREAM_CEILING_GBS", "RunLog", "RunRecorder",
-    "StepCostModel", "gather_bytes_per_epoch", "heartbeat", "load_run",
-    "plan_digest", "roofline_fields", "step_cost", "validate_event",
-    "validate_manifest",
+    "SpanTimer", "StepCostModel", "TraceSummary", "classify_op", "emit_span",
+    "find_trace_files", "gather_bytes_per_epoch", "heartbeat", "load_run",
+    "measured_vs_model_block", "plan_digest", "roofline_fields",
+    "scoped_span", "step_cost", "summarize_trace", "trace_path_for_run",
+    "validate_event", "validate_manifest",
 ]

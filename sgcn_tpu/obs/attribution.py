@@ -43,6 +43,14 @@ from dataclasses import dataclass, field
 # gather-utilization figure — the MFU-analogue for this gather-bound workload.
 STREAM_CEILING_GBS = 655.0
 
+# Nominal v5e per-link ICI rate (400 Gbps/link, each direction) — the
+# serialization rate one wire byte pays in the analytic exchange model.
+# Unlike STREAM_CEILING_GBS this is a DATASHEET figure, not a measured one:
+# the virtual CPU mesh has no ICI to microbenchmark, and the
+# measured_vs_model `exchange` ratio gauge exists precisely to show how far
+# a real mesh lands from it.
+ICI_CEILING_GBS = 50.0
+
 
 def _exchange_gather_rows(plan, comm_schedule: str = "a2a") -> int:
     """Per-chip rows the SELECTED transport's exchange machinery gathers

@@ -117,6 +117,20 @@ class RunRecorder:
         self.manifest["comm_schedule"] = _jsonable(decision)
         self._write_manifest()
 
+    def set_profile(self, profile_dir: str) -> None:
+        """Record where the jax.profiler trace of this run landed (the
+        ``--profile`` + ``--metrics-out`` composition): the directory plus
+        every trace-event JSON found under it with its gzip'd size, so
+        ``scripts/obs_report.py`` can find and parse the trace from the run
+        directory alone (``tracing.trace_path_for_run``)."""
+        from .tracing import find_trace_files
+
+        self.manifest["profile"] = {
+            "dir": os.path.abspath(profile_dir),
+            "trace_files": find_trace_files(profile_dir),
+        }
+        self._write_manifest()
+
     def set_backend(self, mesh=None) -> None:
         """Record the live jax backend + mesh (call after backend init)."""
         import jax
@@ -157,7 +171,7 @@ class RunRecorder:
                        ("roofline", roofline), ("drift", drift)):
             if val is not None:
                 ev[k] = val
-        ev.update(extra)
+        ev.update({k: v for k, v in extra.items() if v is not None})
         self._emit(ev)
 
     def record_eval(self, step: int, loss: float, acc: float | None = None,
@@ -167,6 +181,18 @@ class RunRecorder:
             ev["acc"] = float(acc)
         if wall_s is not None:
             ev["wall_s"] = float(wall_s)
+        self._emit(ev)
+
+    def record_span(self, name: str, dur_s: float, parent: str | None = None,
+                    depth: int = 0, **fields) -> None:
+        """One measured wall-clock span (``obs.tracing.SpanTimer``) — the
+        schema-v2 event that puts measured phase times in the same stream
+        as the analytic gauges."""
+        ev = {"kind": "span", "name": str(name), "dur_s": float(dur_s),
+              "depth": int(depth)}
+        if parent is not None:
+            ev["parent"] = str(parent)
+        ev.update(fields)
         self._emit(ev)
 
     def record_heartbeat(self, event: str, **fields) -> None:
@@ -188,27 +214,37 @@ class RunRecorder:
         return False
 
 
-# ---------------------------------------------------------------- heartbeat
+# ------------------------------------------------- out-of-recorder emission
+def append_env_event(filename: str, ev: dict) -> None:
+    """Validate + append one event to ``$SGCN_METRICS_OUT/<filename>`` — the
+    ONE out-of-recorder emission path (``heartbeat`` pings and
+    ``obs.tracing.emit_span`` bench spans both ride it).  No-op unless the
+    env var names a directory; best-effort by design: a full disk must not
+    kill the run it is observing."""
+    outdir = os.environ.get("SGCN_METRICS_OUT")
+    if not outdir:
+        return
+    try:
+        schema.validate_event(ev)
+        os.makedirs(outdir, exist_ok=True)
+        with open(os.path.join(outdir, filename), "a") as fh:
+            fh.write(json.dumps(_jsonable(ev)) + "\n")
+    except (OSError, ValueError):
+        pass
+
+
 def heartbeat(event: str, **fields) -> None:
     """Append a liveness ping to ``$SGCN_METRICS_OUT/heartbeat.jsonl``.
 
     No-op unless the env var names a directory — callers sprinkle these at
     phase boundaries unconditionally (launch rendezvous, multichip dryrun)
-    and pay nothing when telemetry is off.  Best-effort by design: a
-    full disk must not kill the training run it is observing.
+    and pay nothing when telemetry is off.
     """
-    outdir = os.environ.get("SGCN_METRICS_OUT")
-    if not outdir:
+    if not os.environ.get("SGCN_METRICS_OUT"):
         return
-    ev = {"v": schema.SCHEMA_VERSION, "ts": time.time(), "kind": "heartbeat",
-          "event": str(event), "pid": os.getpid(), **fields}
-    try:
-        schema.validate_event(ev)
-        os.makedirs(outdir, exist_ok=True)
-        with open(os.path.join(outdir, schema.HEARTBEAT_NAME), "a") as fh:
-            fh.write(json.dumps(_jsonable(ev)) + "\n")
-    except (OSError, ValueError):
-        pass
+    append_env_event(schema.HEARTBEAT_NAME, {
+        "v": schema.SCHEMA_VERSION, "ts": time.time(), "kind": "heartbeat",
+        "event": str(event), "pid": os.getpid(), **fields})
 
 
 # -------------------------------------------------------------------- loader
@@ -233,21 +269,24 @@ def load_run(path: str) -> RunLog:
     """Load + validate one run directory.  Raises on schema violations —
     a telemetry consumer must never silently chart garbage.
 
-    A directory holding ONLY ``heartbeat.jsonl`` is valid: the launch/dryrun
-    layers write heartbeats through ``$SGCN_METRICS_OUT`` without a
-    ``RunRecorder`` (no manifest), and the "slow vs stalled" signal must be
-    loadable from exactly that.  ``manifest`` is then ``{}``."""
+    A directory holding ONLY ``heartbeat.jsonl`` or ``events.jsonl`` is
+    valid: the launch/dryrun layers write heartbeats — and ``bench.py`` and
+    its A/B children write spans (``obs.tracing.emit_span``) — through
+    ``$SGCN_METRICS_OUT`` without a ``RunRecorder`` (no manifest), and a
+    killed run's completed measurements must be loadable from exactly
+    that.  ``manifest`` is then ``{}``."""
     mpath = os.path.join(path, schema.MANIFEST_NAME)
     if os.path.exists(mpath):
         with open(mpath) as fh:
             manifest = json.load(fh)
         schema.validate_manifest(manifest)
-    elif os.path.exists(os.path.join(path, schema.HEARTBEAT_NAME)):
+    elif any(os.path.exists(os.path.join(path, n))
+             for n in (schema.HEARTBEAT_NAME, schema.EVENTS_NAME)):
         manifest = {}
     else:
         raise FileNotFoundError(
-            f"{path}: neither {schema.MANIFEST_NAME} nor "
-            f"{schema.HEARTBEAT_NAME} — not a run directory")
+            f"{path}: no {schema.MANIFEST_NAME}, {schema.HEARTBEAT_NAME} "
+            f"or {schema.EVENTS_NAME} — not a run directory")
 
     def read_jsonl(name):
         p = os.path.join(path, name)

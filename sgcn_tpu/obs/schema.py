@@ -19,6 +19,18 @@ Design rules:
 
 Bump ``SCHEMA_VERSION`` on any breaking field change and teach
 ``load_run``/``scripts/obs_report.py`` both versions for one release.
+
+Version history:
+
+  * **v1** — manifest + ``step``/``eval``/``heartbeat``/``summary`` events.
+  * **v2** — the measured-time profiling layer (``obs/tracing.py``): adds
+    the ``span`` event kind (named, optionally nested measured wall-clock
+    spans), the optional ``measured_vs_model`` block on step events
+    (measured-vs-analytic roofline reconciliation), and the optional
+    ``profile`` manifest block (where the jax.profiler trace landed).
+    Purely additive — every valid v1 record is a valid record here, and
+    ``validate_event`` accepts both versions (``SUPPORTED_VERSIONS``); a
+    v1 stream must never carry the v2-only ``span`` kind.
 """
 
 from __future__ import annotations
@@ -26,14 +38,18 @@ from __future__ import annotations
 import math
 import numbers
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+SUPPORTED_VERSIONS = (1, 2)
 
 # event stream file names inside a run directory
 MANIFEST_NAME = "manifest.json"
 EVENTS_NAME = "events.jsonl"
 HEARTBEAT_NAME = "heartbeat.jsonl"
 
-EVENT_KINDS = ("step", "eval", "heartbeat", "summary")
+EVENT_KINDS = ("step", "eval", "heartbeat", "summary", "span")
+# the span kind is a v2 addition; a stream claiming v1 must not carry it
+_KINDS_BY_VERSION = {1: ("step", "eval", "heartbeat", "summary"),
+                     2: EVENT_KINDS}
 
 _NUM = numbers.Real
 _STR = str
@@ -44,6 +60,10 @@ _REQUIRED = {
     "eval": {"step": _NUM, "loss": _NUM},
     "heartbeat": {"event": _STR},
     "summary": {"report": dict},
+    # v2: one measured wall-clock span (obs/tracing.py::SpanTimer) — the
+    # trainers' step/eval phases and bench.py's A/B phases all emit these,
+    # so measured phase times live in the SAME stream as the analytic gauges
+    "span": {"name": _STR, "dur_s": _NUM},
 }
 
 # kind -> {field: type} (optional, typed when present)
@@ -57,10 +77,23 @@ _OPTIONAL = {
         "drift": dict,        # stale-halo drift gauges (see below)
         "epoch": _NUM,
         "batch": _NUM,        # mini-batch trainer: batch index within epoch
+        # v2: measured-vs-analytic reconciliation block (obs/tracing.py):
+        # the span-measured phase-time total of this step joined against
+        # attribution.step_cost per component (ratio + absolute error) —
+        # a mispredicting cost model becomes a visible gauge
+        "measured_vs_model": dict,
     },
     "eval": {"acc": _NUM, "wall_s": _NUM},
     "heartbeat": {"pid": _NUM, "phase": _STR, "detail": _STR},
     "summary": {},
+    "span": {
+        "parent": (str, type(None)),  # enclosing span's name (None = root)
+        "depth": _NUM,        # nesting depth at entry (0 = root)
+        "step": _NUM,         # optimizer step the span belongs to, if any
+        "pid": _NUM,          # emitting process (bench A/B children differ)
+        "phase": _STR,        # coarse phase label (bench arms, trainer fit)
+        "detail": _STR,
+    },
 }
 
 # comm snapshot: the CommStats.report() keys every step event must reconcile
@@ -103,7 +136,21 @@ _MANIFEST_OPTIONAL = {
     # wire-row inputs) — how an 'auto' transport pick is reconstructible
     # from the run directory alone
     "comm_schedule": dict,
+    # v2: where the jax.profiler trace of this run landed (--profile +
+    # --metrics-out composed): directory, trace-event JSON path(s) and
+    # their gzip'd sizes — obs_report.py parses the trace from the run
+    # directory alone (obs/tracing.py::find_trace_files)
+    "profile": dict,
 }
+
+# measured_vs_model component entries: required/optional numeric fields.
+# ``model_s`` is the analytic prediction, ``measured_s`` the span- or
+# trace-derived figure (None = the measured side has no probe for this
+# component in this run); when both are present the writer must also ship
+# the join — ``ratio`` (measured/model) and ``abs_err_s`` (measured−model)
+# — and they must be CONSISTENT with the endpoints (an inconsistent join
+# is a writer bug, not a run fact).
+_MVM_REL_TOL = 1e-6
 
 
 def _check_fields(rec: dict, required: dict, optional: dict, what: str) -> None:
@@ -121,23 +168,80 @@ def _check_fields(rec: dict, required: dict, optional: dict, what: str) -> None:
                 f"{type(rec[f]).__name__}, expected {t}")
 
 
+def _validate_measured_vs_model(mvm: dict) -> None:
+    if not isinstance(mvm.get("phase_total_s"), _NUM) \
+            or isinstance(mvm.get("phase_total_s"), bool) \
+            or not math.isfinite(mvm["phase_total_s"]) \
+            or mvm["phase_total_s"] < 0:
+        raise ValueError(
+            "measured_vs_model: missing/non-finite phase_total_s "
+            f"(got {mvm.get('phase_total_s')!r}) — the span-measured "
+            "phase-time total is the block's anchor")
+    comps = mvm.get("components")
+    if not isinstance(comps, dict) or not comps:
+        raise ValueError(
+            "measured_vs_model: missing/empty components dict")
+    for name, c in comps.items():
+        if not isinstance(c, dict):
+            raise ValueError(
+                f"measured_vs_model component {name!r} is not a dict")
+        ms = c.get("model_s")
+        if not (isinstance(ms, _NUM) and not isinstance(ms, bool)
+                and math.isfinite(ms) and ms >= 0):
+            raise ValueError(
+                f"measured_vs_model component {name!r}: model_s={ms!r} "
+                "(the analytic side must always be computable)")
+        meas = c.get("measured_s")
+        if meas is None:
+            continue
+        if not (isinstance(meas, _NUM) and not isinstance(meas, bool)
+                and math.isfinite(meas) and meas >= 0):
+            raise ValueError(
+                f"measured_vs_model component {name!r}: "
+                f"measured_s={meas!r}")
+        if ms > 0:
+            for f, want in (("ratio", meas / ms), ("abs_err_s", meas - ms)):
+                got = c.get(f)
+                if not (isinstance(got, _NUM) and not isinstance(got, bool)
+                        and math.isfinite(got)
+                        and abs(got - want)
+                        <= _MVM_REL_TOL * max(abs(want), 1.0)):
+                    raise ValueError(
+                        f"measured_vs_model component {name!r}: {f}={got!r} "
+                        f"inconsistent with measured/model endpoints "
+                        f"(expected {want!r}) — the join must be derivable "
+                        "from its own record")
+
+
 def validate_event(ev: dict) -> None:
-    """Raise ``ValueError`` unless ``ev`` is a valid schema-v1 event."""
+    """Raise ``ValueError`` unless ``ev`` is a valid event under its own
+    declared schema version (``SUPPORTED_VERSIONS`` — v1 streams written
+    before the measured-time layer still load)."""
     if not isinstance(ev, dict):
         raise ValueError(f"event must be a dict, got {type(ev).__name__}")
-    kind = ev.get("kind")
-    if kind not in EVENT_KINDS:
-        raise ValueError(f"unknown event kind {kind!r} (know {EVENT_KINDS})")
-    if ev.get("v") != SCHEMA_VERSION:
+    v = ev.get("v")
+    if v not in SUPPORTED_VERSIONS:
         raise ValueError(
-            f"event schema version {ev.get('v')!r} != {SCHEMA_VERSION}")
+            f"event schema version {v!r} not in {SUPPORTED_VERSIONS}")
+    kind = ev.get("kind")
+    kinds = _KINDS_BY_VERSION[v]
+    if kind not in kinds:
+        raise ValueError(
+            f"unknown event kind {kind!r} for schema v{v} (know {kinds})")
     if not isinstance(ev.get("ts"), _NUM):
         raise ValueError(f"event missing numeric ts: {ev}")
     _check_fields(ev, _REQUIRED[kind], _OPTIONAL[kind], f"{kind} event")
     # wall-clock / index health: a NaN here is a recorder bug, not a run fact
-    for f in ("step", "wall_s", "epoch", "batch"):
+    for f in ("step", "wall_s", "epoch", "batch", "dur_s", "depth"):
         if f in ev and isinstance(ev[f], _NUM) and not math.isfinite(ev[f]):
             raise ValueError(f"{kind} event: non-finite {f}={ev[f]}")
+    if kind == "span":
+        if ev["dur_s"] < 0:
+            raise ValueError(f"span event: negative dur_s={ev['dur_s']}")
+        if "depth" in ev and ev["depth"] < 0:
+            raise ValueError(f"span event: negative depth={ev['depth']}")
+    if kind == "step" and isinstance(ev.get("measured_vs_model"), dict):
+        _validate_measured_vs_model(ev["measured_vs_model"])
     if kind == "step" and "comm" in ev and ev["comm"] is not None:
         comm = ev["comm"]
         missing = [k for k in COMM_SPLIT_KEYS if k not in comm]
@@ -194,10 +298,26 @@ def validate_event(ev: dict) -> None:
 
 
 def validate_manifest(m: dict) -> None:
-    """Raise ``ValueError`` unless ``m`` is a valid schema-v1 manifest."""
+    """Raise ``ValueError`` unless ``m`` is a valid manifest under its own
+    declared schema version (v1 manifests still load)."""
     if not isinstance(m, dict):
         raise ValueError(f"manifest must be a dict, got {type(m).__name__}")
-    if m.get("v") != SCHEMA_VERSION:
+    if m.get("v") not in SUPPORTED_VERSIONS:
         raise ValueError(
-            f"manifest schema version {m.get('v')!r} != {SCHEMA_VERSION}")
+            f"manifest schema version {m.get('v')!r} not in "
+            f"{SUPPORTED_VERSIONS}")
     _check_fields(m, _MANIFEST_REQUIRED, _MANIFEST_OPTIONAL, "manifest")
+    prof = m.get("profile")
+    if isinstance(prof, dict):
+        if not isinstance(prof.get("dir"), str):
+            raise ValueError(
+                f"manifest profile block missing string 'dir': {prof}")
+        tf = prof.get("trace_files")
+        if tf is not None and not (
+                isinstance(tf, list)
+                and all(isinstance(e, dict) and isinstance(e.get("path"), str)
+                        and isinstance(e.get("bytes"), _NUM)
+                        for e in tf)):
+            raise ValueError(
+                "manifest profile.trace_files must be a list of "
+                f"{{path, bytes}} dicts, got {tf!r}")

@@ -1,0 +1,497 @@
+"""Measured-time profiling layer — spans, trace parsing, model reconciliation.
+
+Every perf gauge in ``attribution.py`` is ANALYTIC: derived from the
+``CommPlan``, it says how fast a step *should* be.  This module is the
+measured-time source of truth next to it, in two halves:
+
+**Span API** (``SpanTimer`` / ``emit_span`` / ``scoped_span``) — named,
+optionally nested wall-clock spans with ``block_until_ready`` sync points.
+It generalizes ``utils.timers.PhaseTimer`` (every span IS a phase: the timer
+keeps the CAGNET-vocabulary self-time breakdown, the span additionally
+becomes a schema-v2 ``span`` event in the run's ``events.jsonl``), so
+measured phase times land in the SAME stream as the analytic gauges.  Both
+trainers thread their step/epoch paths through it, and ``bench.py``'s A/B
+children emit arm-level spans through the env-gated ``emit_span`` (span the
+arms, never the steps inside a timed region — instrumentation inside a
+differential-timing loop would perturb the very number being measured).
+
+**Trace parser** (``find_trace_files`` / ``summarize_trace``) — parses the
+trace-event JSON ``jax.profiler.trace`` writes (``--profile DIR`` →
+``DIR/plugins/profile/<run>/*.trace.json.gz``), classifies device ops into
+the attribution vocabulary (spmm / dense / exchange / collective-wait /
+other; table below and in ``docs/observability.md``) and computes MEASURED
+overlap fraction, exposed-comm time and per-device skew (the straggler
+gauge) — the quantities the analytic model only predicts.
+
+**Reconciliation** (``measured_vs_model_block``) — joins a step's measured
+span times against ``attribution.step_cost`` into the per-step
+``measured_vs_model`` block (ratio + absolute error per component,
+schema-validated), so a mispredicting cost model is a visible gauge instead
+of a footnote.  ``scripts/obs_report.py`` renders both the per-step blocks
+and the post-hoc trace join.
+
+Nothing here imports jax at module scope (CLIs configure the backend before
+heavy imports).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+
+# NOTE: no module-scope import of ..utils.timers — it imports jax, and the
+# trace parser half of this module must stay importable in a jax-free
+# context (SpanTimer imports PhaseTimer lazily)
+
+# ---------------------------------------------------------------- span API
+
+
+@dataclass
+class Span:
+    """Handle yielded by ``SpanTimer.span`` — filled at exit."""
+
+    name: str
+    parent: str | None = None
+    depth: int = 0
+    dur_s: float = 0.0
+
+
+class SpanTimer:
+    """Nested measured spans over a shared ``PhaseTimer``.
+
+    One instance per trainer: ``timer`` keeps the phase breakdown (self
+    time per name — the ``PhaseTimer`` nesting contract), and, when a
+    ``RunRecorder`` is attached, every span exit appends one validated
+    ``span`` event.  Without a recorder the only cost is the timer's two
+    ``perf_counter`` reads — the default hot path stays un-instrumented.
+    """
+
+    def __init__(self, timer=None, recorder=None):
+        from ..utils.timers import PhaseTimer
+
+        self.timer = timer if timer is not None else PhaseTimer()
+        self.recorder = recorder
+        self._stack: list[str] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str, sync=None, step: int | None = None,
+             phase: str | None = None):
+        """Time a named span (nesting under any open span).  ``sync`` is the
+        ``PhaseTimer.phase`` sync callable — evaluated after the body, so
+        the span duration includes the device-side completion it blocks on.
+        Yields a ``Span`` whose ``dur_s`` is valid after exit."""
+        sp = Span(name=name,
+                  parent=self._stack[-1] if self._stack else None,
+                  depth=len(self._stack))
+        self._stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            with self.timer.phase(name, sync=sync):
+                yield sp
+        finally:
+            sp.dur_s = time.perf_counter() - t0
+            self._stack.pop()
+            if self.recorder is not None:
+                kw = {}
+                if step is not None:
+                    kw["step"] = int(step)
+                if phase is not None:
+                    kw["phase"] = str(phase)
+                self.recorder.record_span(
+                    name=sp.name, dur_s=sp.dur_s, parent=sp.parent,
+                    depth=sp.depth, **kw)
+
+
+def emit_span(name: str, dur_s: float, parent: str | None = None,
+              depth: int = 0, phase: str | None = None,
+              detail: str | None = None) -> None:
+    """Append one validated ``span`` event to
+    ``$SGCN_METRICS_OUT/events.jsonl`` — the out-of-recorder span emitter
+    (``recorder.append_env_event``, the same path ``heartbeat`` rides):
+    ``bench.py`` and its A/B child processes inherit the env var, so their
+    arm-level measured times land in the parent run's event stream.  No-op
+    without the env var; best-effort by design (a full disk must not kill
+    the bench it is observing)."""
+    if not os.environ.get("SGCN_METRICS_OUT"):
+        return
+    from . import schema
+    from .recorder import append_env_event
+    ev = {"v": schema.SCHEMA_VERSION, "ts": time.time(), "kind": "span",
+          "name": str(name), "dur_s": float(dur_s), "depth": int(depth),
+          "pid": os.getpid()}
+    if parent is not None:
+        ev["parent"] = str(parent)
+    if phase is not None:
+        ev["phase"] = str(phase)
+    if detail is not None:
+        ev["detail"] = str(detail)
+    append_env_event(schema.EVENTS_NAME, ev)
+
+
+@contextlib.contextmanager
+def scoped_span(name: str, phase: str | None = None,
+                detail: str | None = None):
+    """Time a region and ``emit_span`` it at exit (env-gated no-op without
+    ``$SGCN_METRICS_OUT``) — the bench-side span form."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        emit_span(name, time.perf_counter() - t0, phase=phase, detail=detail)
+
+
+# ------------------------------------------------------------ trace parser
+
+# The ONE collective-op name alternation both comm classes build on: the
+# `collective_wait` pattern matches these names' `-done` halves and the
+# `exchange` pattern the ops themselves, so a new collective (a ragged
+# all-to-all lowering, say) added here lands in BOTH — two hand-kept copies
+# would silently diverge and skew comm_s with no test failing.
+_COLLECTIVES = (
+    r"all-to-all|all_to_all|collective-permute|collective_permute|"
+    r"ppermute|all-reduce|all_reduce|all-gather|all_gather|"
+    r"reduce-scatter|reduce_scatter")
+
+# Ordered op-classification table (first match wins, case-insensitive).
+# The vocabulary is attribution.py's: spmm (the gather/scatter aggregation
+# streams), dense (projections), exchange (the halo transport collectives),
+# collective_wait (blocked-on-peer time), other (remaining device compute —
+# copies, broadcasts, elementwise fusions).  docs/observability.md carries
+# the human-readable form of this table; this tuple is the executable one.
+TRACE_OP_CLASSES: tuple = (
+    # only COLLECTIVE -done ops are comm wait: a bare `(^|-)done` would also
+    # catch XLA's async `copy-done` (host/device copies) and inflate comm_s
+    ("collective_wait", re.compile(
+        r"rendezvous|^wait\b|^wait:|"
+        r"(" + _COLLECTIVES + r"|send|recv)[-.]done", re.I)),
+    # paired point-to-point transfers (multi-host / pipelined lowerings)
+    # count as exchange too — booking `send.3` as compute would understate
+    # comm_s and overstate the measured overlap gauge
+    ("exchange", re.compile(
+        _COLLECTIVES + r"|\bsend\b|\brecv\b", re.I)),
+    # `convolution`, not `conv`: a bare `conv` would classify every bf16
+    # `convert` cast as dense in a codebase with no convolutions at all
+    ("dense", re.compile(
+        r"\bdot\b|^dot|dot_general|gemm|matmul|convolution", re.I)),
+    ("spmm", re.compile(
+        r"gather|scatter|select_slice|dynamic.?slice|dynamic.?update|"
+        r"segment", re.I)),
+)
+
+# events that are host/runtime scaffolding, not device op time
+_TRACE_SKIP = re.compile(
+    r"^\$|^end: |^ThreadpoolListener|^ThunkExecutor|^PjitFunction|"
+    r"^XlaModule|^Pjit|^jit[_(]|^BufferAssignment|^TransferManager|"
+    r"^Stream|^Execute$|^RunExecutable|^CopyToDevice|^CopyFromDevice",
+    re.I)
+
+TRACE_CLASSES = ("spmm", "dense", "exchange", "collective_wait", "other")
+
+
+def classify_op(name: str) -> str | None:
+    """Map one trace-event name into the attribution vocabulary; ``None``
+    for host/runtime scaffolding that is not device op time."""
+    if not name or _TRACE_SKIP.search(name):
+        return None
+    for cls, pat in TRACE_OP_CLASSES:
+        if pat.search(name):
+            return cls
+    return "other"
+
+
+def find_trace_files(profile_dir: str) -> list[dict]:
+    """Locate the trace-event JSON files under a ``--profile`` directory
+    (``plugins/profile/<run>/*.trace.json.gz``), newest run first.
+    Returns ``[{path, bytes}]`` — the shape the manifest ``profile`` block
+    records, so ``obs_report`` can find the trace from the run dir alone."""
+    hits = sorted(
+        glob.glob(os.path.join(profile_dir, "**", "*.trace.json.gz"),
+                  recursive=True),
+        key=lambda p: os.path.getmtime(p), reverse=True)
+    return [{"path": os.path.abspath(p), "bytes": os.path.getsize(p)}
+            for p in hits]
+
+
+def _interval_union(iv: list) -> list:
+    """Merge [start, end) intervals into a disjoint sorted union."""
+    if not iv:
+        return []
+    iv = sorted(iv)
+    out = [list(iv[0])]
+    for s, e in iv[1:]:
+        if s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return out
+
+
+def _overlap_len(a: list, b: list) -> float:
+    """Total intersection length of two DISJOINT SORTED interval unions."""
+    total = 0.0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if s < e:
+            total += e - s
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+@dataclass
+class TraceSummary:
+    """Measured per-device attribution of one profiler trace."""
+
+    path: str
+    n_events: int
+    devices: dict = field(default_factory=dict)   # name -> per-class seconds
+    classes: dict = field(default_factory=dict)   # per-class totals (s)
+    # comm WALL-CLOCK: per-pid interval union of the exchange +
+    # collective_wait ops, summed over pids — ≤ the per-class op-second
+    # sums whenever async collectives overlap each other on one device
+    # (the same de-overlapping the exposed/hidden split needs)
+    comm_s: float = 0.0
+    exposed_comm_s: float = 0.0    # comm not covered by concurrent compute
+    measured_overlap_frac: float | None = None    # 1 − exposed/comm
+    skew: dict | None = None       # straggler gauge (multi-device only)
+
+    def per_step(self, nsteps: int) -> dict:
+        """Average the trace totals over ``nsteps`` optimizer steps — the
+        per-step measured figures to join against ``step_cost``.
+
+        ``nsteps`` must count EVERY optimizer step the trace covers — the
+        recorded step events do (the trainer records warmup steps too), so
+        ``len(log.steps())`` is the right denominator for a ``--profile``
+        run.  Anything else executing inside the profiled region that is
+        not a recorded step (``evaluate()`` forward passes, first-dispatch
+        autotuning) still lands in the numerator, so these per-step figures
+        are UPPER bounds there — ``obs_report`` prints the eval count next
+        to the join when a run carries both."""
+        n = max(int(nsteps), 1)
+        out = {f"{c}_s": self.classes.get(c, 0.0) / n
+               for c in TRACE_CLASSES}
+        out["comm_s"] = self.comm_s / n
+        out["exposed_comm_s"] = self.exposed_comm_s / n
+        return out
+
+
+def summarize_trace(path: str) -> TraceSummary:
+    """Parse one ``*.trace.json.gz`` (or plain ``.json``) trace-event file
+    into measured per-device op-class times, overlap/exposed-comm figures
+    and the straggler gauge.
+
+    Device attribution: trace processes (``pid``) map to devices on TPU
+    (one pid per ``/device:TPU:n``); the CPU backend runs every virtual
+    device in one ``/host:CPU`` pid, so per-device skew is only emitted
+    when the trace distinguishes more than one device-like pid.  When any
+    ``/device:…`` pid exists, host/runtime pids are dropped entirely —
+    their wall time is not device op time and must not skew the gauges.  Overlap is
+    computed per pid: comm intervals (exchange + collective-wait) minus
+    their intersection with the union of concurrent compute intervals
+    (spmm/dense/other, any thread of the pid) — comm time under compute is
+    hidden, the remainder is EXPOSED comm sitting on the critical path."""
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as fh:
+        doc = json.load(fh)
+    events = doc.get("traceEvents", [])
+    proc_names: dict = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            proc_names[e.get("pid")] = e.get("args", {}).get("name",
+                                                             str(e.get("pid")))
+    per_dev: dict = {}
+    intervals: dict = {}           # pid -> {"comm": [...], "compute": [...]}
+    pid_counts: dict = {}          # per pid, so the filter below keeps
+    for e in events:               # n_events consistent with the gauges
+        if e.get("ph") != "X":
+            continue
+        cls = classify_op(e.get("name", ""))
+        if cls is None:
+            continue
+        dur = float(e.get("dur", 0.0)) * 1e-6      # trace units: µs
+        ts = float(e.get("ts", 0.0)) * 1e-6
+        pid = e.get("pid")
+        dev = per_dev.setdefault(pid, {c: 0.0 for c in TRACE_CLASSES})
+        dev[cls] += dur
+        bucket = intervals.setdefault(pid, {"comm": [], "compute": []})
+        bucket["comm" if cls in ("exchange", "collective_wait")
+               else "compute"].append((ts, ts + dur))
+        pid_counts[pid] = pid_counts.get(pid, 0) + 1
+
+    # a real TPU profile carries host/runtime pids next to the device pids
+    # (enqueue threads, transfer spans) — when the trace distinguishes any
+    # `/device:…` pid, only those are devices: host wall time must not
+    # inflate class totals, and a host pid must never be elected straggler.
+    # A CPU-backend trace has no `/device:` pid at all, so every pid (the
+    # single `/host:CPU`) stays in — its op classes ARE the measurement.
+    dev_pids = [p for p in per_dev
+                if "/device:" in proc_names.get(p, str(p)).lower()]
+    if dev_pids:
+        per_dev = {p: per_dev[p] for p in dev_pids}
+    n_classified = sum(pid_counts[p] for p in per_dev)
+
+    classes = {c: sum(d[c] for d in per_dev.values()) for c in TRACE_CLASSES}
+    comm_s = exposed_s = 0.0
+    devices = {}
+    busies = {}
+    for pid, dev in per_dev.items():
+        name = proc_names.get(pid, str(pid))
+        if name in devices:
+            # distinct pids can share process_name metadata (merged
+            # multi-host captures) — collapsing them would shrink the
+            # straggler denominator and overwrite per-class seconds
+            name = f"{name} [pid {pid}]"
+        busy_union = _interval_union(intervals[pid]["comm"]
+                                     + intervals[pid]["compute"])
+        busy = sum(e - s for s, e in busy_union)
+        compute_union = _interval_union(intervals[pid]["compute"])
+        comm_union = _interval_union(intervals[pid]["comm"])
+        cm = sum(e - s for s, e in comm_union)
+        hidden = _overlap_len(comm_union, compute_union)
+        comm_s += cm
+        exposed_s += max(0.0, cm - hidden)
+        devices[name] = dict(dev, busy_s=busy)
+        busies[name] = busy
+    skew = None
+    if len(busies) > 1:
+        mean = sum(busies.values()) / len(busies)
+        straggler = max(busies, key=busies.get)
+        skew = {"busy_max_over_mean": (busies[straggler] / mean
+                                       if mean > 0 else 1.0),
+                "straggler": straggler}
+    overlap = None
+    if comm_s > 0:
+        overlap = 1.0 - exposed_s / comm_s
+    return TraceSummary(path=path, n_events=n_classified, devices=devices,
+                        classes=classes, comm_s=comm_s,
+                        exposed_comm_s=exposed_s,
+                        measured_overlap_frac=overlap, skew=skew)
+
+
+def trace_path_for_run(manifest: dict, rundir: str | None = None) -> str | None:
+    """Resolve the run's trace-event file from its manifest ``profile``
+    block (falling back to re-globbing the recorded profile dir, then the
+    run directory itself) — how ``obs_report`` finds the trace from the run
+    directory alone.  The manifest records ABSOLUTE paths from the machine
+    the run executed on; for a relocated run dir (the normal way a TPU run
+    is inspected) those are stale, so the last resort globs ``rundir`` —
+    copying the profile tree into the run dir makes the claim literally
+    true anywhere."""
+    prof = manifest.get("profile") if isinstance(manifest, dict) else None
+    if isinstance(prof, dict):
+        for entry in prof.get("trace_files") or []:
+            p = entry.get("path")
+            if p and os.path.exists(p):
+                return p
+        d = prof.get("dir")
+        if d and os.path.isdir(d):
+            hits = find_trace_files(d)
+            if hits:
+                return hits[0]["path"]
+    if rundir and os.path.isdir(rundir):
+        hits = find_trace_files(rundir)
+        if hits:
+            return hits[0]["path"]
+    return None
+
+
+# ----------------------------------------------------------- reconciliation
+
+def _sig(x: float, n: int = 6) -> float:
+    return float(f"{x:.{n}g}")
+
+
+def _mvm_entry(model_s: float, measured_s: float | None) -> dict:
+    """One measured_vs_model component: model/measured endpoints plus the
+    derived join (ratio + absolute error) whenever both are present."""
+    d = {"model_s": _sig(model_s)}
+    if measured_s is None:
+        d["measured_s"] = None
+        return d
+    d["measured_s"] = _sig(float(measured_s))
+    if d["model_s"] > 0:
+        d["ratio"] = d["measured_s"] / d["model_s"]
+        d["abs_err_s"] = d["measured_s"] - d["model_s"]
+    return d
+
+
+def exchange_join(trace_per_step: dict, exposed_halo_bytes: float) -> dict:
+    """The ``exchange`` component of ``measured_vs_model``: measured
+    per-step EXPOSED comm seconds (``TraceSummary.per_step``'s
+    ``exposed_comm_s`` — comm minus what ran under concurrent compute)
+    joined against the analytic exposed wire bytes serialized at the
+    nominal ICI rate (``exposed_halo_bytes / ICI_CEILING_GBS`` — the
+    roofline's ``exposed_halo_bytes`` gauge restated in seconds, exactly
+    how ``gather_stream`` restates ``stream_ceiling_frac``).  Both sides
+    are exposed figures — joining the measured TOTAL collective seconds
+    here would conflate overlap (hidden comm) with cost-model error — and
+    both are exchange-shaped: ``exposed_comm_frac`` is a fraction of the
+    step's EXCHANGES, not of its wall, so an earlier ``frac × wall_s``
+    model side equated "all exchanges exposed" with "the whole step is
+    comm" and reported a 1/comm-share ratio as model error on every exact
+    run.  The ONE implementation of this join — ``measured_vs_model_block``
+    embeds it per step, ``scripts/obs_report.py`` renders it post-hoc over
+    the whole-run trace."""
+    from .attribution import ICI_CEILING_GBS
+
+    return _mvm_entry(
+        max(float(exposed_halo_bytes), 0.0) / (ICI_CEILING_GBS * 1e9),
+        trace_per_step.get("exposed_comm_s", 0.0))
+
+
+def measured_vs_model_block(cost, wall_s: float,
+                            phase_total_s: float | None = None,
+                            trace_per_step: dict | None = None,
+                            exposed_halo_bytes: float | None = None) -> dict:
+    """Join measured step time against the analytic ``StepCostModel`` into
+    the schema-validated per-step ``measured_vs_model`` block.
+
+    Components (each ``{model_s, measured_s, ratio, abs_err_s}``; ratio =
+    measured/model — >1 means the step ran SLOWER than the analytic model
+    predicts, the drift gauge for a stale cost model):
+
+      * ``gather_stream`` — model: ``gather_bytes / STREAM_CEILING_GBS``
+        (the analytic gather-bound step time — the workload's roofline
+        axis); measured: the step's span-measured wall time.  The ratio is
+        exactly ``1 / stream_ceiling_frac`` — the same reconciliation the
+        roofline block states as a fraction, restated as seconds so model
+        error is readable as absolute time.
+      * ``exchange`` (only when a parsed profiler trace is joined —
+        ``trace_per_step`` from ``TraceSummary.per_step`` plus the
+        analytic ``exposed_halo_bytes`` from the roofline block): measured
+        per-step EXPOSED comm seconds (``exposed_comm_s``) against the
+        analytic exposed wire bytes serialized at the nominal ICI rate
+        (``exposed_halo_bytes / ICI_CEILING_GBS``) — exposed vs exposed
+        and both exchange-shaped, so the ratio reads as cost-model error,
+        not overlap.  The other trace classes are NOT joined here
+        — the analytic model predicts no per-class seconds for them
+        (bytes and FLOPs, not times); ``obs_report`` renders their
+        measured figures next to this block instead.
+
+    ``phase_total_s`` defaults to ``wall_s`` — the span-measured total this
+    block anchors on must reconcile with ``PhaseTimer.report()`` (tier-1
+    pins <1% on the cora fixture)."""
+    from .attribution import STREAM_CEILING_GBS
+
+    wall_s = float(wall_s)
+    comps = {
+        "gather_stream": _mvm_entry(
+            cost.gather_bytes / (STREAM_CEILING_GBS * 1e9), wall_s),
+    }
+    if trace_per_step is not None and exposed_halo_bytes is not None:
+        comps["exchange"] = exchange_join(trace_per_step, exposed_halo_bytes)
+    return {
+        "phase_total_s": _sig(wall_s if phase_total_s is None
+                              else float(phase_total_s)),
+        "components": comps,
+    }

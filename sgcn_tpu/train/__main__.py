@@ -275,6 +275,11 @@ def main() -> None:
         if recorder is not None:
             # the parity harness drives its own trainers; record the run's
             # identity + outcome (no per-step stream for this experiment)
+            if args.profile:
+                # --profile and --metrics-out compose: the manifest records
+                # where the trace landed (and its gzip'd size), so
+                # obs_report.py parses it from the run directory alone
+                recorder.set_profile(args.profile)
             recorder.record_summary(report)
             recorder.close()
         if ctx.is_coordinator:
@@ -322,6 +327,12 @@ def main() -> None:
                 start_step = load_checkpoint(state, args.resume)
             data = make_train_data(plan, feats, labels)
             report = tr.fit(data, epochs=args.epochs, warmup=args.warmup)
+    if recorder is not None and args.profile:
+        # --profile and --metrics-out compose: the jax.profiler trace is
+        # flushed when the `with prof:` context above exits, so NOW the
+        # manifest can record its path and gzip'd size — obs_report.py
+        # finds and parses the trace from the run directory alone
+        recorder.set_profile(args.profile)
     if args.save_checkpoint and ctx.is_coordinator:
         # coordinator-only write (multi-host ranks share the filesystem);
         # step accumulates across chained resumes.  Warm-up epochs are real

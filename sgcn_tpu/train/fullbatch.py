@@ -22,7 +22,7 @@ the compiler-native form of the reference's Irecv/compute/Waitany overlap
 
 from __future__ import annotations
 
-import time
+import contextlib
 from dataclasses import dataclass
 from typing import Any
 
@@ -334,6 +334,11 @@ class FullBatchTrainer:
         # the ONE code path for phase boundaries (fit()'s wall-clock and the
         # JSONL phase records both read it; sync= callables sit at each
         # block_until_ready boundary)
+        from ..obs.tracing import SpanTimer
+        self.spans = SpanTimer(timer=self.timer)   # measured-span layer
+        # over the same timer: without a recorder a span IS a phase (two
+        # perf_counter reads); with one, every span exit appends a
+        # schema-v2 span event (docs/observability.md, measured vs analytic)
         self._step_count = 0
         self._cost_cache = {}       # lazy obs.attribution.step_cost models,
         # keyed by step kind (sync vs stale) — under --halo-delta the
@@ -880,6 +885,7 @@ class FullBatchTrainer:
         the fused loop cannot surface; detach (``recorder=None``) to get the
         one-dispatch path back."""
         self.recorder = recorder
+        self.spans.recorder = recorder   # span exits now emit span events
         if getattr(self, "comm_decision", None):
             # the schedule-selection inputs (resolve_comm_schedule) land in
             # the run manifest, so an 'auto' pick is reconstructible from
@@ -926,8 +932,9 @@ class FullBatchTrainer:
     def _record_step_event(self, loss: float, err, gnorm, wall_s: float,
                            drift: dict | None) -> None:
         from ..obs.attribution import roofline_fields
+        from ..obs.tracing import measured_vs_model_block
 
-        roofline = None
+        roofline = mvm = None
         # same honesty gate as bench.py: the gather model describes the
         # bucketed slot-pass aggregators (GCN ELL, GAT combined-edge) — for
         # the Pallas VMEM kernel it would describe a program that didn't
@@ -943,6 +950,11 @@ class FullBatchTrainer:
             roofline = roofline_fields(cost, wall_s,
                                        exchanges=ex_step,
                                        exposed_exchanges=exposed_step)
+            # measured-vs-analytic reconciliation: the span-measured step
+            # time joined against the same cost model, per component —
+            # wall_s here IS the step span's duration, so the block's
+            # phase_total_s reconciles with PhaseTimer.report() exactly
+            mvm = measured_vs_model_block(cost, wall_s)
         self.recorder.record_step(
             step=self._step_count, loss=loss, wall_s=wall_s,
             err=float(err) if self.loss_name == "bce" else None,
@@ -951,6 +963,7 @@ class FullBatchTrainer:
             phases=self.timer.report() or None,
             drift=drift,
             roofline=roofline,
+            measured_vs_model=mvm,
         )
 
     @staticmethod
@@ -999,33 +1012,43 @@ class FullBatchTrainer:
         event (loss, grad-norm, wall time, cumulative comm split, roofline
         attribution, stale-mode drift gauges) — the readback this implies
         makes ``sync=False`` behave like ``sync=True`` for timing purposes."""
-        t0 = time.perf_counter()
         if self.halo_staleness:
-            loss, err, extra = self._stale_run_one(data)
+            # under a recorder, the step span brackets dispatch AND the loss
+            # readback (the sync point), so its duration is the measured
+            # step time the event's wall_s and measured_vs_model block both
+            # carry; nullcontext keeps ONE copy of the step bookkeeping for
+            # the plain path (which stays readback-free under sync=False)
+            cm = (self.spans.span("step", step=self._step_count + 1)
+                  if self.recorder is not None else contextlib.nullcontext())
+            with cm as sp:
+                loss, err, extra = self._stale_run_one(data)
+                if self.recorder is not None:
+                    loss = float(loss)
             self.last_err = err
             self._step_count += 1
             if self.recorder is not None:
                 gnorm, gauges, age, sync_step = extra
-                loss = float(loss)
                 self._record_step_event(
-                    loss, err, gnorm, time.perf_counter() - t0,
+                    loss, err, gnorm, sp.dur_s,
                     drift=self._drift_fields(
                         gauges, age, sync_step,
                         rr_sizes=(self.plan.rr_sizes
                                   if self.comm_schedule == "ragged"
                                   else None)))
+                return loss
             return float(loss) if sync else loss
         if self.recorder is not None:
-            self.params, self.opt_state, loss, err, gnorm = self._step_tel(
-                self.params, self.opt_state, self.pa, data.h0, data.labels,
-                data.train_valid,
-            )
+            with self.spans.span("step", step=self._step_count + 1) as sp:
+                self.params, self.opt_state, loss, err, gnorm = \
+                    self._step_tel(
+                        self.params, self.opt_state, self.pa, data.h0,
+                        data.labels, data.train_valid,
+                    )
+                loss = float(loss)      # readback = the span's sync point
             self.last_err = err
             self.stats.count_step(nlayers=self.nlayers)
             self._step_count += 1
-            loss = float(loss)
-            self._record_step_event(loss, err, gnorm,
-                                    time.perf_counter() - t0, drift=None)
+            self._record_step_event(loss, err, gnorm, sp.dur_s, drift=None)
             return loss
         self.params, self.opt_state, loss, err = self._step(
             self.params, self.opt_state, self.pa, data.h0, data.labels,
@@ -1037,8 +1060,7 @@ class FullBatchTrainer:
         return float(loss) if sync else loss
 
     def evaluate(self, data: TrainData) -> tuple[float, float]:
-        t0 = time.perf_counter()
-        with self.timer.phase("eval"):
+        with self.spans.span("eval") as sp:
             loss, acc, _ = self._eval(
                 self.params, self.pa, data.h0, data.labels, data.eval_valid
             )
@@ -1046,8 +1068,7 @@ class FullBatchTrainer:
         self.stats.count_forward(nlayers=self.nlayers)
         if self.recorder is not None:
             self.recorder.record_eval(step=self._step_count, loss=loss,
-                                      acc=acc,
-                                      wall_s=time.perf_counter() - t0)
+                                      acc=acc, wall_s=sp.dur_s)
         return loss, acc
 
     def predict(self, data: TrainData) -> np.ndarray:
@@ -1072,25 +1093,29 @@ class FullBatchTrainer:
         """Epoch loop with reference-style timing: ``warmup`` untimed epochs,
         then wall-clock over the timed ones (``GPU/PGCN.py:202-228``).
 
-        Phase boundaries route through ``self.timer`` (the CAGNET-vocabulary
-        ``PhaseTimer``) with a ``sync=`` callable at each block_until_ready
-        boundary — the SAME accounting the per-step JSONL events snapshot,
-        so ``report()['phases']`` and the event stream cannot disagree
-        (previously the boundaries were raw ``perf_counter`` reads that
-        never reached the timer)."""
+        Phase boundaries route through ``self.spans`` (the measured-span
+        layer over the CAGNET-vocabulary ``PhaseTimer``) with a ``sync=``
+        callable at each block_until_ready boundary — the SAME accounting
+        the per-step JSONL events snapshot, so ``report()['phases']`` and
+        the event stream cannot disagree.  Under a recorder, ``step()``
+        opens its own nested ``step`` span inside each epoch's
+        ``train_step`` span, so the epoch totals read from the timer's
+        INCLUSIVE side (the nested span claims the self time)."""
         data = TrainData(**shard_stacked(self.mesh, vars(data)))
         history: list[float] = []
-        t_prior = self.timer.totals["train_step"]   # fit() may be re-entered
-        with self.timer.phase("warmup", sync=lambda: self.params):
+        # fit() may be re-entered — measure the delta, inclusive of any
+        # nested step spans the telemetry path opens
+        t_prior = self.timer.inclusive_total("train_step")
+        with self.spans.span("warmup", sync=lambda: self.params):
             for _ in range(warmup):
                 self.step(data)
         for ep in range(epochs):
-            with self.timer.phase("train_step", sync=lambda: self.params):
+            with self.spans.span("train_step", sync=lambda: self.params):
                 loss = self.step(data)
             history.append(loss)
             if verbose:
                 print(f"epoch {ep}: loss {loss:.6f}", flush=True)
-        elapsed = self.timer.totals["train_step"] - t_prior
+        elapsed = self.timer.inclusive_total("train_step") - t_prior
         report = self.stats.report()
         report.update(
             epochs=epochs,

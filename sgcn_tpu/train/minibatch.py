@@ -20,7 +20,7 @@ pre-sample-everything strategy (SURVEY.md §7.3).
 
 from __future__ import annotations
 
-import time
+import contextlib
 from dataclasses import dataclass
 
 import jax
@@ -173,6 +173,9 @@ class MiniBatchTrainer:
         emits no per-step events — use the stepwise ``fit`` under
         telemetry."""
         self.recorder = recorder
+        # span events ride the inner trainer's SpanTimer (one timer, one
+        # span stack for both trainers — docs/observability.md)
+        self.inner.spans.recorder = recorder
         if getattr(self, "comm_decision", None):
             recorder.set_comm_schedule(self.comm_decision)
 
@@ -243,11 +246,18 @@ class MiniBatchTrainer:
 
     # ------------------------------------------------------------------- api
     def step(self, batch: Batch) -> float:
-        t0 = time.perf_counter()
         tr = self.inner
-        tr.params, tr.opt_state, loss, tr.last_err = tr._step(
-            tr.params, tr.opt_state, batch.pa, batch.data.h0,
-            batch.data.labels, batch.data.train_valid)
+        # under a recorder, the step span brackets dispatch AND the loss
+        # readback, so its duration is the measured step time the event
+        # carries; without one, nullcontext keeps the SAME body (one copy
+        # of the step bookkeeping for both paths)
+        cm = (tr.spans.span("step", step=self._gstep + 1)
+              if self.recorder is not None else contextlib.nullcontext())
+        with cm as sp:
+            tr.params, tr.opt_state, loss, tr.last_err = tr._step(
+                tr.params, tr.opt_state, batch.pa, batch.data.h0,
+                batch.data.labels, batch.data.train_valid)
+            loss = float(loss)
         # per-batch counters advance exactly like the full-batch trainer's —
         # the reference's mini-batch code shares one counter dict across
         # batches (GPU/PGCN-Mini-batch.py), so end-of-run stats carry the
@@ -255,12 +265,10 @@ class MiniBatchTrainer:
         batch.stats.count_step(nlayers=self.nlayers)
         self._gstep += 1
         if self.recorder is not None:
-            loss = float(loss)          # the event readback syncs the step
             self.recorder.record_step(
-                step=self._gstep, loss=loss,
-                wall_s=time.perf_counter() - t0,
+                step=self._gstep, loss=loss, wall_s=sp.dur_s,
                 comm=self._comm_snapshot(batch.stats))
-        return float(loss)
+        return loss
 
     def fit(self, features: np.ndarray, labels: np.ndarray,
             train_mask: np.ndarray | None = None, epochs: int = 1,
@@ -270,22 +278,25 @@ class MiniBatchTrainer:
         through the inner trainer's ``PhaseTimer`` (one phase-accounting
         code path for both trainers)."""
         timer = self.inner.timer
+        spans = self.inner.spans
         batches = self.make_batches(features, labels, train_mask)
-        with timer.phase("warmup", sync=lambda: self.inner.params):
+        with spans.span("warmup", sync=lambda: self.inner.params):
             for _ in range(warmup):
                 self.step(batches[0])
         history = []
-        t_prior = timer.totals["train_step"]
+        # inclusive: under a recorder each batch step opens a nested span
+        # that claims the self time (utils/timers.py nesting contract)
+        t_prior = timer.inclusive_total("train_step")
         for ep in range(epochs):
             ep_loss = 0.0
-            with timer.phase("train_step", sync=lambda: self.inner.params):
+            with spans.span("train_step", sync=lambda: self.inner.params):
                 for b in batches:
                     ep_loss += self.step(b)
             ep_loss /= len(batches)
             history.append(ep_loss)
             if verbose:
                 print(f"epoch {ep}: batch-avg loss {ep_loss:.6f}", flush=True)
-        elapsed = timer.totals["train_step"] - t_prior
+        elapsed = timer.inclusive_total("train_step") - t_prior
         report = CommStats.merged_report([b.stats for b in batches])
         report.update(
             epochs=epochs,

@@ -43,6 +43,8 @@ SUBPROCESS_BUDGET_ALLOWLIST = {
                            "~50 s together)",
     "test_validate_bench.py": "two validate_bench.py CLI children — pure "
                               "stdlib JSON checks, sub-second, no jax",
+    "test_bench_trend.py": "three bench_trend.py CLI children — pure "
+                           "stdlib JSON trend checks, sub-second, no jax",
 }
 
 _SPAWN_RE = re.compile(

@@ -10,6 +10,10 @@ so the events must carry
   * roofline utilization populated from the analytic cost model;
   * drift-gauge fields, present and finite, with the full-sync schedule
     visible in ``sync_step``/``staleness_age``;
+  * the measured-time layer (PR-7): span events for every step/epoch
+    phase, a per-step ``measured_vs_model`` block whose measured
+    phase-time total reconciles with ``PhaseTimer.report()`` to <1%, and
+    a manifest ``profile`` block pointing at a parseable profiler trace;
 
 and ``scripts/obs_report.py`` must render the directory.
 """
@@ -124,6 +128,71 @@ def test_drift_gauges_present_and_finite(telemetry_run):
     assert max(ages) <= 2                   # --sync-every 2 bounds the age
 
 
+def test_span_events_thread_the_step_and_epoch_paths(telemetry_run):
+    """Every optimizer step emits a nested 'step' span under its epoch's
+    'train_step' span (warmup steps under 'warmup') — measured phase times
+    in the SAME stream as the analytic gauges."""
+    _, metrics, _ = telemetry_run
+    from sgcn_tpu.obs import load_run
+    log = load_run(metrics)
+    spans = [e for e in log.events if e["kind"] == "span"]
+    steps = [s for s in spans if s["name"] == "step"]
+    assert len(steps) == 4              # 1 warmup + 3 timed epochs
+    assert {s["parent"] for s in steps} == {"warmup", "train_step"}
+    assert all(s["depth"] == 1 for s in steps)
+    assert [s["step"] for s in steps] == [1, 2, 3, 4]
+    epochs = [s for s in spans if s["name"] == "train_step"]
+    assert len(epochs) == 3 and all(s["depth"] == 0 for s in epochs)
+    # span durations ARE the step wall times the step events carry
+    walls = [e["wall_s"] for e in log.steps()]
+    for sp, w in zip(steps, walls):
+        assert abs(sp["dur_s"] - w) < 1e-6
+
+
+def test_measured_vs_model_reconciles_with_phase_timer(telemetry_run):
+    """The acceptance inequality: the measured phase-time total across the
+    per-step measured_vs_model blocks reconciles with PhaseTimer.report()
+    (the 'step' phase the spans feed) to <1%."""
+    _, metrics, _ = telemetry_run
+    from sgcn_tpu.obs import load_run
+    steps = load_run(metrics).steps()
+    mvms = [ev["measured_vs_model"] for ev in steps]
+    assert all(isinstance(m, dict) for m in mvms)
+    measured_total = sum(m["phase_total_s"] for m in mvms)
+    # the LAST step's phases snapshot is taken after its span exits, so it
+    # covers every step span of the run
+    ph = steps[-1]["phases"]["step"]
+    assert ph["count"] == len(steps)
+    assert abs(measured_total - ph["total_s"]) < 0.01 * ph["total_s"]
+    for ev in steps:
+        gs = ev["measured_vs_model"]["components"]["gather_stream"]
+        assert gs["measured_s"] > 0 and gs["model_s"] > 0
+        # the seconds-space ratio is the roofline fraction, inverted
+        # (both sides round to a few significant digits)
+        frac = ev["roofline"]["stream_ceiling_frac"]
+        assert abs(gs["ratio"] * frac - 1.0) < 0.01
+
+
+def test_profile_trace_recorded_in_manifest_and_parses(telemetry_run):
+    """--profile and --metrics-out compose: the manifest records the trace
+    path + gzip'd size, and the trace parses into classified op time from
+    the run directory alone."""
+    _, metrics, _ = telemetry_run
+    from sgcn_tpu.obs import load_run, summarize_trace, trace_path_for_run
+    log = load_run(metrics)
+    prof = log.manifest["profile"]
+    assert prof["trace_files"], "no trace files recorded in the manifest"
+    entry = prof["trace_files"][0]
+    assert os.path.exists(entry["path"])
+    assert entry["bytes"] == os.path.getsize(entry["path"])
+    tpath = trace_path_for_run(log.manifest, metrics)
+    assert tpath == entry["path"]
+    ts = summarize_trace(tpath)
+    assert ts.n_events > 0
+    assert sum(ts.classes.values()) > 0
+    assert 0 <= ts.exposed_comm_s <= ts.comm_s + 1e-9
+
+
 @pytest.fixture(scope="module")
 def ragged_run(tmp_path_factory):
     """A second CLI child on the cora fixture under the RAGGED schedule
@@ -204,3 +273,8 @@ def test_obs_report_renders(telemetry_run):
     assert "drift gauges" in out
     assert "exposed" in out and "hidden" in out
     assert "stream-ceiling" in out
+    # the measured-time layer renders too: spans, the per-step
+    # measured-vs-model reconciliation, and the trace-derived attribution
+    assert "spans:" in out
+    assert "measured vs model" in out
+    assert "trace (" in out and "measured op classes" in out
