@@ -906,6 +906,121 @@ def bench_ragged_stale_ab_child(ahat, feats, labels, widths, epochs: int,
     }
 
 
+def bench_serve_qps(n: int, avg_deg: int, f: int, widths, graph: str = "ba"):
+    """Sustained-QPS serving bench on the 8-virtual-device CPU mesh (the
+    ``serve_qps_8dev`` block): synthetic open-loop traffic at a fixed
+    offered rate against the forward-only serve engine
+    (``sgcn_tpu/serve/``), reporting achieved QPS + p50/p99 latency per
+    transport, and an a2a-vs-ragged serving A/B asserting the wire-row win
+    carries over to the forward-only path.  One child process runs both
+    arms over shared state (the between-process variance lesson of
+    ``bench_stale_ab``); degrades to a marked partial block on failure."""
+    block: dict = {"serve_qps_8dev": None}
+    try:
+        child = _run_vdev_child(n, avg_deg, f, widths, 2, graph,
+                                extra_args=("--serve-qps-child",))
+        child.pop("metric", None)
+        child.pop("value", None)
+        block["serve_qps_8dev"] = child
+        return block
+    except subprocess.TimeoutExpired:
+        print("# serve QPS run exceeded its deadline", file=sys.stderr)
+        block["serve_qps_degraded"] = "deadline"
+        return block
+    except Exception as e:                      # noqa: BLE001 — diagnostic path
+        print(f"# serve QPS run failed: {e!r}", file=sys.stderr)
+        block["serve_qps_degraded"] = repr(e)[:200]
+        return block
+
+
+def bench_serve_qps_child(ahat, feats, labels, widths, graph: str,
+                          offered_qps: float = 50.0,
+                          latency_budget_ms: float = 100.0,
+                          max_batch: int = 16, queries: int = 200) -> dict:
+    """One-process serving A/B (the ``--serve-qps-child`` body): the SAME
+    hp-partitioned plan, features and open-loop query trace served through
+    an a2a engine and a ragged engine back to back.
+
+    The asserted figure is the WIRE-ROW accounting: inference has no
+    gradient ring, so the forward halo exchange is the entire comm cost and
+    the ragged ring must ship strictly fewer wire rows than the dense pad
+    on the skewed hp partition (asserted here and re-checked by
+    ``scripts/validate_bench.py``).  CPU-mesh latency/QPS are measured live
+    and reported honestly — p50/p99 under ``measured: true`` provenance —
+    but never the cross-transport claim (no ICI: the ring's k−1 dispatches
+    are host overhead here)."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from sgcn_tpu.parallel import build_comm_plan
+    from sgcn_tpu.partition import partition_hypergraph_colnet
+    from sgcn_tpu.serve import ServeEngine, run_loadgen, synthetic_query_ids
+
+    k = len(jax.devices())
+    n = ahat.shape[0]
+    if k > 1:
+        pv, km1 = partition_hypergraph_colnet(ahat, k, seed=0)
+    else:
+        pv, km1 = np.zeros(n, dtype=np.int64), 0
+    plan = build_comm_plan(ahat, pv, k)
+    plan.ensure_ragged()
+    qids = synthetic_query_ids(n, queries, seed=0)
+    out: dict = {
+        "n": n, "graph": graph, "k": k, "km1": int(km1),
+        # nnz + nlayers scope the trend series: the wire-row counters are
+        # plan-derived, so a denser graph or a deeper model is a DIFFERENT
+        # measurement, not a regression (the _TIME_CFG_KEYS lesson)
+        "nnz": int(ahat.nnz), "nlayers": len(widths),
+        "offered_qps": offered_qps,
+        "latency_budget_ms": latency_budget_ms,
+        "max_batch": max_batch,
+        # live host-clock latency measurement from THIS process — the serve
+        # flavor of the epoch-time provenance flag (validate_bench checks)
+        "measured": True,
+        "weights": "random-init",   # serving latency is weight-agnostic;
+        #                             parity vs evaluate() is tier-1's job
+        "arms": {},
+        "note": "CPU-mesh latency/QPS are measured live and reported "
+                "honestly but are NOT the cross-transport claim (no ICI; "
+                "ring dispatches are host overhead here) — the asserted "
+                "figure is the wire-row accounting: the forward exchange "
+                "is serving's entire comm cost, and ragged must ship "
+                "strictly fewer wire rows than a2a on the skewed hp "
+                "partition",
+    }
+    wire = {}
+    from sgcn_tpu.obs.tracing import scoped_span
+    for sched in ("a2a", "ragged"):
+        eng = ServeEngine(plan, fin=feats.shape[1], widths=widths,
+                          comm_schedule=sched, max_batch=max_batch,
+                          latency_budget_ms=latency_budget_ms, seed=0)
+        eng.set_features(feats)
+        eng.warmup(qids)     # every bucket, outside the measured window
+        with scoped_span(f"bench:serve_qps:{sched}", phase="serve_child",
+                         detail=f"n={n} graph={graph}"):
+            res = run_loadgen(eng, qids, offered_qps=offered_qps)
+        g = eng.gauges()
+        wire[sched] = g["wire_rows_per_exchange"]
+        out["arms"][sched] = {
+            **res.summary(),
+            "deadline_flushes": eng.batcher.deadline_flushes,
+            "full_flushes": eng.batcher.full_flushes,
+            "compiles": g["compiles"],
+            "buckets": g["buckets"],
+            "wire_rows_per_exchange": g["wire_rows_per_exchange"],
+            "wire_rows_per_query": g["wire_rows_per_query"],
+            "true_rows_per_exchange": g["true_rows_per_exchange"],
+        }
+    if k > 1 and not wire["ragged"] < wire["a2a"]:
+        # the acceptance invariant carried over from training: per-round
+        # pads must beat the global pad on the skewed partition
+        raise RuntimeError(
+            f"serve A/B (hp): wire_rows_ragged={wire['ragged']} not below "
+            f"wire_rows_a2a={wire['a2a']}")
+    return out
+
+
 def bench_ab_baseline(args, rev: str) -> dict:
     """Same-session code A/B for the GB-table regime (VERDICT r4 item 9).
 
@@ -1131,6 +1246,13 @@ def main() -> None:
                    help="graph size for the GAT ragged A/B child (one "
                         "extra CPU-mesh run; smaller than --ragged-ab-n — "
                         "the attention tables make the arms heavier)")
+    p.add_argument("--skip-serve-qps", action="store_true",
+                   help="skip the sustained-QPS serving bench "
+                        "(serve_qps_8dev: open-loop traffic + a2a-vs-ragged "
+                        "serving A/B) on the virtual 8-device mesh")
+    p.add_argument("--serve-qps-n", type=int, default=20_000,
+                   help="graph size for the serve QPS child (forward-only, "
+                        "lighter than the training A/Bs)")
     p.add_argument("--skip-ragged-stale-ab", action="store_true",
                    help="skip the three-way composed-mode A/B (a2a+stale "
                         "vs ragged+exact vs ragged+stale) on the virtual "
@@ -1178,6 +1300,8 @@ def main() -> None:
     p.add_argument("--gat-ragged-ab-child", action="store_true",
                    help=argparse.SUPPRESS)
     p.add_argument("--ragged-stale-ab-child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--serve-qps-child", action="store_true",
                    help=argparse.SUPPRESS)
     args = p.parse_args()
 
@@ -1237,6 +1361,15 @@ def main() -> None:
             "value": None,      # the three-arm block is the payload
             **bench_ragged_stale_ab_child(ahat, feats, labels, widths,
                                           args.epochs, graph=args.graph),
+        }))
+        return
+
+    if args.serve_qps_child:
+        print(json.dumps({
+            "metric": "serve_qps_ab",
+            "value": None,      # the per-transport arm blocks are the payload
+            **bench_serve_qps_child(ahat, feats, labels, widths,
+                                    graph=args.graph),
         }))
         return
 
@@ -1360,6 +1493,12 @@ def main() -> None:
             vdev_metrics.update(bench_ragged_stale_ab(
                 args.ragged_stale_ab_n, args.avg_deg, args.f, widths,
                 max(2, args.epochs // 2), graph=args.vdev_graph))
+        if (args.model == "gcn" and args.halo_staleness == 0
+                and not args.skip_serve_qps):
+            # the serving roofline next to the training one (docs/serving.md)
+            vdev_metrics.update(bench_serve_qps(
+                args.serve_qps_n, args.avg_deg, args.f, widths,
+                graph=args.vdev_graph))
     extra = {}
     if not args.vdev_child:
         extra.update(products_partition_block())

@@ -34,6 +34,12 @@ Rules:
     (``COUNTER_KEYS``: ``km1_8dev``, ``comm_volume_rows_8dev``) get a ZERO
     band: they are plan-derived, reproducible bit-for-bit, and may never
     increase within a series.
+  * **Serving series** (PR-8) — the ``serve_qps_8dev`` block's measured
+    latency quantiles / achieved QPS register as REPORT-ONLY series (their
+    non-"s" units keep them outside the lower-is-better time band — a
+    latency gate can be added once rounds establish the band), while the
+    plan-derived per-query/per-exchange wire-row gauges are zero-band
+    counters like ``km1_8dev``.
   * **Degradation-marker aware** — a record with ``rc != 0``, or a null
     ``value`` carrying a ``skipped``/``degraded`` marker, is a GAP in the
     series (reported, never compared): the graceful-degradation contract
@@ -61,6 +67,19 @@ ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 COUNTER_KEYS = ("km1_8dev", "comm_volume_rows_8dev")
 # flagship keys that scope a counter series to one diagnostic config
 _DIAG_CFG_KEYS = ("n_8dev", "graph_8dev", "partitioner_8dev")
+# serving-bench series (PR-8, the serve_qps_8dev block): measured latency
+# quantiles and achieved QPS are REPORT-ONLY at first (registered with a
+# non-"s" unit so the lower-is-better time band never applies — the PR-7
+# unit rule; a gate can be added once a few rounds establish the band),
+# while the plan-derived per-query wire-row gauge is a zero-band counter
+SERVE_REPORT_KEYS = ("latency_p50_ms", "latency_p99_ms", "achieved_qps")
+SERVE_COUNTER_KEYS = ("wire_rows_per_query", "wire_rows_per_exchange")
+# serve config fields that scope a serving series (a different graph size /
+# density / depth / rate / batch shape is a different measurement, not a
+# regression — nnz/nlayers matter because the zero-band wire-row counters
+# are plan- and depth-derived)
+_SERVE_CFG_KEYS = ("n", "graph", "nnz", "nlayers", "k", "offered_qps",
+                   "max_batch")
 # scalar bench-config fields that scope a wall-clock series: a round run at
 # a different problem size / model / dtype is a DIFFERENT measurement, not
 # a regression (graph already keys separately)
@@ -141,6 +160,23 @@ def extract_series(history) -> tuple[dict, list]:
                 if _is_num(parsed.get(ck)):
                     series[("counter", ck) + cfg].append(
                         (rnd, float(parsed[ck])))
+        # serving-bench series (see SERVE_* docstrings above): per transport
+        # arm, report-only latency/QPS + zero-band wire-row counters
+        sv = parsed.get("serve_qps_8dev")
+        if isinstance(sv, dict) and isinstance(sv.get("arms"), dict):
+            scfg = tuple(sv.get(k) for k in _SERVE_CFG_KEYS)
+            for arm, e in sv["arms"].items():
+                if not isinstance(e, dict):
+                    continue
+                for rk in SERVE_REPORT_KEYS:
+                    if _is_num(e.get(rk)):
+                        series[("metric", f"serve_{arm}_{rk}", "serve",
+                                rk.rsplit("_", 1)[-1]) + scfg].append(
+                            (rnd, float(e[rk])))
+                for ck in SERVE_COUNTER_KEYS:
+                    if _is_num(e.get(ck)):
+                        series[("counter", f"serve_{arm}_{ck}")
+                               + scfg].append((rnd, float(e[ck])))
     return dict(series), gaps
 
 
@@ -182,6 +218,15 @@ def check_series(series: dict, time_band: float = DEFAULT_TIME_BAND) -> list:
 
 
 def _key_name(key: tuple) -> str:
+    if key[0] == "metric" and len(key) > 2 and key[2] == "serve":
+        cfg = [f"{k}={c}" for k, c in zip(_SERVE_CFG_KEYS, key[4:])
+               if c is not None]
+        return f"{key[1]} ({key[3]}" \
+               + (", " + ", ".join(cfg) if cfg else "") + ")"
+    if key[0] == "counter" and key[1].startswith("serve_"):
+        cfg = [f"{k}={c}" for k, c in zip(_SERVE_CFG_KEYS, key[2:])
+               if c is not None]
+        return f"{key[1]} ({', '.join(cfg)})"
     if key[0] in ("time", "metric"):
         cfg = [f"{k}={c}" for k, c in zip(_TIME_CFG_KEYS, key[4:])
                if c is not None]

@@ -270,6 +270,42 @@ def render(path: str, max_steps: int = 12) -> str:
                     f"{_fmt(ts.skew['busy_max_over_mean'], 4)}x mean busy "
                     "(per-device skew gauge)")
 
+    serves = log.serves()
+    if serves:
+        lines.append(f"\nserve windows: {len(serves)} "
+                     "(sgcn_tpu/serve latency gauges, schema v3)")
+        for sv in serves:
+            line = (f"  {int(sv['queries'])} queries @ "
+                    f"{_fmt(sv['achieved_qps'])} QPS achieved"
+                    + (f" (offered {_fmt(sv['offered_qps'])}, "
+                       f"{sv.get('mode', '?')} loop)"
+                       if sv.get("offered_qps") is not None else
+                       f" ({sv.get('mode', '?')} loop)"))
+            lines.append(line)
+            lines.append(
+                f"    latency ms: p50 {_fmt(sv['latency_p50_ms'])}  "
+                f"p95 {_fmt(sv['latency_p95_ms'])}  "
+                f"p99 {_fmt(sv['latency_p99_ms'])}"
+                + (f"  (budget {_fmt(sv['latency_budget_ms'])})"
+                   if sv.get("latency_budget_ms") is not None else ""))
+            if sv.get("batches") is not None:
+                lines.append(
+                    f"    batches {int(sv['batches'])} "
+                    f"(mean {_fmt(sv.get('mean_batch'))} queries; "
+                    f"{int(sv.get('full_flushes', 0))} full / "
+                    f"{int(sv.get('deadline_flushes', 0))} deadline "
+                    "flushes)")
+            if sv.get("compiles") is not None:
+                lines.append(
+                    f"    compiles {int(sv['compiles'])} over buckets "
+                    f"{sv.get('buckets')} — steady-state windows must "
+                    "show 0 (the no-recompile contract)")
+            if sv.get("wire_rows_per_query") is not None:
+                lines.append(
+                    f"    wire ({sv.get('comm_schedule', '?')} schedule): "
+                    f"{_fmt(sv['wire_rows_per_query'])} rows/query "
+                    "(analytic, plan-derived)")
+
     for ev in log.evals():
         lines.append(f"\neval @ step {ev['step']}: loss {_fmt(ev['loss'])}"
                      + (f", acc {_fmt(ev['acc'])}" if "acc" in ev else ""))

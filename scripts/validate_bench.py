@@ -49,6 +49,14 @@ What counts as a violation:
     records predate the flag and retro-stamping provenance onto history
     would itself be a hand-edit); a ``measured`` flag that is present but
     not literally ``true`` is a violation at ANY round;
+  * **serving-bench accounting** (PR-8): a ``serve_qps_8dev`` block must
+    carry both transport arms with positive achieved QPS, ordered positive
+    latency quantiles under ``measured: true`` provenance, compile counters
+    within the pre-compiled bucket count (a runtime recompile violates the
+    bucket contract), a STRICT ragged-vs-a2a wire-row win on the skewed hp
+    partition (the forward-only carry-over of the training schedules'
+    acceptance figure — never CPU-mesh latency; the ``note`` says so), or
+    be ``null`` with a ``serve_qps_degraded`` marker;
   * **the pow2-k RB constraint** (``products_ksweep.json``): ``hp_rb``
     entries at non-power-of-two k, or k < 32.  The PR-2 review incident:
     ``partition_hypergraph_rb`` recurses on k/2 and the auto-select
@@ -169,6 +177,86 @@ def check_bench_record(rec: dict) -> list[str]:
             errs += check_ragged_ab(parsed, prefix="gat_ragged_ab")
         if "ragged_stale_ab_8dev" in parsed:
             errs += check_ragged_stale_ab(parsed)
+        if "serve_qps_8dev" in parsed:
+            errs += check_serve_qps(parsed)
+    return errs
+
+
+def check_serve_qps(parsed: dict) -> list[str]:
+    """The serving-bench block contract (PR-8): a ``serve_qps_8dev`` block
+    must carry both transport arms (a2a, ragged) with positive achieved QPS,
+    ordered positive latency quantiles UNDER ``measured: true`` provenance
+    (latency claims are live host-clock measurements, same rule as the
+    epoch-time flag), zero steady-state recompiles implied by consistent
+    bucket/compile counters, and the wire-row accounting in which the
+    ragged arm ships STRICTLY fewer wire rows than a2a on the skewed hp
+    partition — the forward-only carry-over of the training schedules' win
+    (never CPU-mesh latency; the block's ``note`` must say so).  ``null``
+    needs a ``serve_qps_degraded`` marker."""
+    errs = []
+    block = parsed["serve_qps_8dev"]
+    if block is None:
+        if not isinstance(parsed.get("serve_qps_degraded"), str):
+            errs.append("serve_qps_8dev null without a serve_qps_degraded "
+                        "marker (graceful-degradation contract)")
+        return errs
+    if not isinstance(block, dict):
+        return [f"serve_qps_8dev is {type(block).__name__}, expected "
+                "dict or null"]
+    if block.get("measured") is not True:
+        errs.append("serve_qps_8dev: latency claims without measured:true "
+                    "provenance — quantiles must come from a live "
+                    "measurement in the emitting process")
+    if not (_is_num(block.get("offered_qps")) and block["offered_qps"] > 0):
+        errs.append(f"serve_qps_8dev: offered_qps="
+                    f"{block.get('offered_qps')!r}")
+    arms = block.get("arms")
+    if not isinstance(arms, dict):
+        return errs + ["serve_qps_8dev carries no arms dict"]
+    missing = [a for a in ("a2a", "ragged") if not isinstance(arms.get(a),
+                                                             dict)]
+    if missing:
+        return errs + [f"serve_qps_8dev missing arm(s) {missing}"]
+    for nm in ("a2a", "ragged"):
+        e = arms[nm]
+        if not (_is_num(e.get("achieved_qps")) and e["achieved_qps"] > 0):
+            errs.append(f"serve_qps_8dev.arms.{nm}.achieved_qps="
+                        f"{e.get('achieved_qps')!r}")
+        p50, p99 = e.get("latency_p50_ms"), e.get("latency_p99_ms")
+        if not (_is_num(p50) and _is_num(p99) and 0 < p50 <= p99):
+            errs.append(f"serve_qps_8dev.arms.{nm}: latency quantiles "
+                        f"p50={p50!r} p99={p99!r} (need 0 < p50 <= p99)")
+        for key in ("wire_rows_per_exchange", "wire_rows_per_query"):
+            if not (_is_num(e.get(key)) and e[key] >= 0):
+                errs.append(f"serve_qps_8dev.arms.{nm}.{key}="
+                            f"{e.get(key)!r}")
+        comp = e.get("compiles")
+        bkts = e.get("buckets")
+        if comp is not None and isinstance(bkts, list):
+            if not (_is_num(comp) and comp <= len(bkts)):
+                errs.append(
+                    f"serve_qps_8dev.arms.{nm}: compiles={comp!r} exceeds "
+                    f"the {len(bkts)} pre-compiled buckets — a runtime "
+                    "recompile violates the bucket contract")
+    if errs:
+        return errs
+    wa = arms["a2a"]["wire_rows_per_exchange"]
+    wr = arms["ragged"]["wire_rows_per_exchange"]
+    if not wr < wa:
+        errs.append(f"serve_qps_8dev: wire_rows_ragged={wr!r} not STRICTLY "
+                    f"below wire_rows_a2a={wa!r} on the skewed partition — "
+                    "the forward-only carry-over of the schedule's "
+                    "acceptance figure")
+    tr_, wq = (arms["ragged"].get("true_rows_per_exchange"),
+               arms["ragged"]["wire_rows_per_exchange"])
+    if _is_num(tr_) and tr_ > wq:
+        errs.append(f"serve_qps_8dev: true_rows={tr_!r} above "
+                    f"wire_rows_ragged={wq!r}")
+    note = block.get("note")
+    if not (isinstance(note, str) and "wire" in note):
+        errs.append("serve_qps_8dev: missing the honest-measurement note "
+                    "naming the wire-row accounting as the asserted figure "
+                    "(CPU-mesh latency is not the cross-transport claim)")
     return errs
 
 

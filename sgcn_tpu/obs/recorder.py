@@ -195,6 +195,21 @@ class RunRecorder:
         ev.update(fields)
         self._emit(ev)
 
+    def record_serve(self, queries: int, achieved_qps: float,
+                     latency_p50_ms: float, latency_p95_ms: float,
+                     latency_p99_ms: float, **fields) -> None:
+        """One serving latency/throughput window (schema v3,
+        ``sgcn_tpu/serve/engine.py``): measured per-query latency quantiles
+        + achieved QPS, with the batching/compile counters and the analytic
+        per-query wire-row gauge riding along as optional fields."""
+        ev = {"kind": "serve", "queries": int(queries),
+              "achieved_qps": float(achieved_qps),
+              "latency_p50_ms": float(latency_p50_ms),
+              "latency_p95_ms": float(latency_p95_ms),
+              "latency_p99_ms": float(latency_p99_ms)}
+        ev.update({k: v for k, v in fields.items() if v is not None})
+        self._emit(ev)
+
     def record_heartbeat(self, event: str, **fields) -> None:
         self._emit({"kind": "heartbeat", "event": str(event),
                     "pid": os.getpid(), **fields})
@@ -263,6 +278,9 @@ class RunLog:
 
     def summaries(self) -> list:
         return [e for e in self.events if e["kind"] == "summary"]
+
+    def serves(self) -> list:
+        return [e for e in self.events if e["kind"] == "serve"]
 
 
 def load_run(path: str) -> RunLog:

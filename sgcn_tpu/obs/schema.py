@@ -31,6 +31,11 @@ Version history:
     Purely additive — every valid v1 record is a valid record here, and
     ``validate_event`` accepts both versions (``SUPPORTED_VERSIONS``); a
     v1 stream must never carry the v2-only ``span`` kind.
+  * **v3** — the serving subsystem (``sgcn_tpu/serve/``): adds the
+    ``serve`` event kind — one latency/throughput window of the inference
+    engine (query count, achieved QPS, p50/p95/p99 latency, batching and
+    compile counters, per-query wire-row gauge).  Purely additive again:
+    v1/v2 streams load unchanged and must not carry the v3-only kind.
 """
 
 from __future__ import annotations
@@ -38,18 +43,20 @@ from __future__ import annotations
 import math
 import numbers
 
-SCHEMA_VERSION = 2
-SUPPORTED_VERSIONS = (1, 2)
+SCHEMA_VERSION = 3
+SUPPORTED_VERSIONS = (1, 2, 3)
 
 # event stream file names inside a run directory
 MANIFEST_NAME = "manifest.json"
 EVENTS_NAME = "events.jsonl"
 HEARTBEAT_NAME = "heartbeat.jsonl"
 
-EVENT_KINDS = ("step", "eval", "heartbeat", "summary", "span")
-# the span kind is a v2 addition; a stream claiming v1 must not carry it
+EVENT_KINDS = ("step", "eval", "heartbeat", "summary", "span", "serve")
+# the span kind is a v2 addition and the serve kind a v3 one; a stream
+# claiming an older version must not carry a newer kind
 _KINDS_BY_VERSION = {1: ("step", "eval", "heartbeat", "summary"),
-                     2: EVENT_KINDS}
+                     2: ("step", "eval", "heartbeat", "summary", "span"),
+                     3: EVENT_KINDS}
 
 _NUM = numbers.Real
 _STR = str
@@ -64,6 +71,14 @@ _REQUIRED = {
     # trainers' step/eval phases and bench.py's A/B phases all emit these,
     # so measured phase times live in the SAME stream as the analytic gauges
     "span": {"name": _STR, "dur_s": _NUM},
+    # v3: one serving latency/throughput window (sgcn_tpu/serve/engine.py):
+    # measured per-query latency quantiles + achieved QPS over `queries`
+    # completed queries.  The quantiles are MEASURED figures (host clock
+    # around submit→result), so the validator holds them to the same
+    # health rules as wall_s — finite, non-negative, and ordered.
+    "serve": {"queries": _NUM, "achieved_qps": _NUM,
+              "latency_p50_ms": _NUM, "latency_p95_ms": _NUM,
+              "latency_p99_ms": _NUM},
 }
 
 # kind -> {field: type} (optional, typed when present)
@@ -93,6 +108,22 @@ _OPTIONAL = {
         "pid": _NUM,          # emitting process (bench A/B children differ)
         "phase": _STR,        # coarse phase label (bench arms, trainer fit)
         "detail": _STR,
+    },
+    "serve": {
+        "window_s": _NUM,       # wall-clock span of this window
+        "offered_qps": _NUM,    # open-loop target rate (absent closed-loop)
+        "mode": _STR,           # 'open' or 'closed' loop generator
+        "batches": _NUM,        # micro-batches executed
+        "mean_batch": _NUM,     # mean queries per micro-batch
+        "deadline_flushes": _NUM,   # flushed by the latency budget
+        "full_flushes": _NUM,       # flushed by max-batch
+        "latency_budget_ms": _NUM,
+        "compiles": _NUM,       # AOT bucket compiles (0 in steady state —
+        #                         the no-recompile contract's gauge)
+        "buckets": list,        # padded batch-size buckets pre-compiled
+        "comm_schedule": _STR,  # resolved transport of the forward
+        "wire_rows_per_query": _NUM,   # analytic: L·wire_rows/exchange ÷
+        #                                max_batch (plan-derived, zero-band)
     },
 }
 
@@ -240,6 +271,26 @@ def validate_event(ev: dict) -> None:
             raise ValueError(f"span event: negative dur_s={ev['dur_s']}")
         if "depth" in ev and ev["depth"] < 0:
             raise ValueError(f"span event: negative depth={ev['depth']}")
+    if kind == "serve":
+        for f in ("queries", "achieved_qps", "latency_p50_ms",
+                  "latency_p95_ms", "latency_p99_ms", "window_s",
+                  "offered_qps", "batches", "mean_batch",
+                  "deadline_flushes", "full_flushes", "latency_budget_ms",
+                  "compiles", "wire_rows_per_query"):
+            if f in ev and isinstance(ev[f], _NUM) and (
+                    not math.isfinite(ev[f]) or ev[f] < 0):
+                raise ValueError(
+                    f"serve event: non-finite/negative {f}={ev[f]}")
+        p50, p95, p99 = (ev["latency_p50_ms"], ev["latency_p95_ms"],
+                         ev["latency_p99_ms"])
+        if not p50 <= p95 <= p99:
+            raise ValueError(
+                f"serve event: latency quantiles out of order "
+                f"(p50={p50}, p95={p95}, p99={p99}) — a quantile "
+                "inversion is a writer bug, not a run fact")
+        if "mode" in ev and ev["mode"] not in ("open", "closed"):
+            raise ValueError(
+                f"serve event: mode={ev['mode']!r} not 'open'/'closed'")
     if kind == "step" and isinstance(ev.get("measured_vs_model"), dict):
         _validate_measured_vs_model(ev["measured_vs_model"])
     if kind == "step" and "comm" in ev and ev["comm"] is not None:

@@ -1,0 +1,19 @@
+"""Serving subsystem: AOT-compiled partitioned inference (docs/serving.md).
+
+The first non-training workload: ``ServeEngine`` loads a checkpoint + plan
+(provenance-verified), AOT-compiles a forward-only per-partition step per
+padded batch-size bucket, ``VertexRouter`` maps query vertex ids to owning
+chips, ``MicroBatcher`` batches against a latency budget, and ``loadgen``
+drives synthetic open/closed-loop traffic.  CLI: ``python -m sgcn_tpu.serve``.
+"""
+
+from .batcher import MicroBatcher, default_buckets
+from .engine import SERVE_STAGES, ServeEngine
+from .loadgen import ServeResult, run_loadgen, synthetic_query_ids
+from .router import SERVE_ROUTER_FIELDS, VertexRouter
+
+__all__ = [
+    "MicroBatcher", "SERVE_ROUTER_FIELDS", "SERVE_STAGES", "ServeEngine",
+    "ServeResult", "VertexRouter", "default_buckets", "run_loadgen",
+    "synthetic_query_ids",
+]

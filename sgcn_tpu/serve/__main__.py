@@ -1,0 +1,222 @@
+"""Serving CLI — sustained synthetic query traffic over a checkpoint + plan.
+
+::
+
+    python -m sgcn_tpu.serve --npz SNAP.npz --normalize -p PARTVEC -s 8 \\
+        -b cpu --checkpoint CKPT.npz --qps 100 --latency-budget-ms 50 \\
+        --queries 500 --comm-schedule ragged --metrics-out RUNDIR
+
+Mirrors the trainer CLI's data/backend flags (``sgcn_tpu.train``), loads the
+model config from the checkpoint's provenance block when present (CLI flags
+are the fallback for pre-provenance checkpoints / ``--random-init``), drives
+the open- (``--qps N``) or closed-loop (``--qps 0``) generator, and prints
+ONE JSON line: achieved QPS + p50/p95/p99 latency + batching/compile/wire
+gauges.  Under ``--metrics-out`` the window also lands as a schema-v3
+``serve`` event (rendered by ``scripts/obs_report.py``).
+
+The backend env setup must happen before JAX initializes, so heavy imports
+are deferred into ``main`` after arg parsing (same rule as the trainer CLI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="sgcn_tpu partitioned inference")
+    p.add_argument("-a", "--adjacency", default=None,
+                   help=".mtx adjacency (or use --npz)")
+    p.add_argument("--npz", default=None,
+                   help="planetoid/ogbn-style .npz snapshot")
+    p.add_argument("--features-mtx", default=None)
+    p.add_argument("--normalize", action="store_true",
+                   help="apply Â normalization to the input adjacency")
+    p.add_argument("-p", "--partvec", required=True,
+                   help="part vector: text (.gp/.hp/.rp) or pickle")
+    p.add_argument("-b", "--backend", default="jax", choices=["jax", "cpu"])
+    p.add_argument("-s", "--nparts", type=int, required=True)
+    p.add_argument("--checkpoint", default=None,
+                   help="trainer checkpoint .npz; its provenance block "
+                        "(plan digest + model config) is verified and "
+                        "supplies model/widths when present")
+    p.add_argument("--random-init", action="store_true",
+                   help="serve fresh Glorot-init weights instead of a "
+                        "checkpoint (latency benching only — the JSON "
+                        "records it)")
+    p.add_argument("--model", default=None, choices=["gcn", "gat"],
+                   help="fallback when the checkpoint carries no config")
+    p.add_argument("-l", "--nlayers", type=int, default=2)
+    p.add_argument("-f", "--nfeatures", type=int, default=16)
+    p.add_argument("--hidden", type=int, default=None)
+    p.add_argument("--classes", type=int, default=None,
+                   help="output width (default: labels' class count when "
+                        "the snapshot carries labels, else nfeatures)")
+    p.add_argument("--comm-schedule", default=None,
+                   choices=["a2a", "ragged", "auto"],
+                   help="halo transport of the forward exchange "
+                        "(docs/comm_schedule.md; inference has no gradient "
+                        "ring, so this is the ENTIRE comm cost)")
+    p.add_argument("--halo-dtype", default=None, choices=["bfloat16"],
+                   help="wire-only exchange dtype (GCN)")
+    p.add_argument("--qps", type=float, default=0.0,
+                   help="offered query rate (open loop); 0 = closed loop "
+                        "(saturation probe)")
+    p.add_argument("--queries", type=int, default=200,
+                   help="total synthetic queries in the window")
+    p.add_argument("--latency-budget-ms", type=float, default=50.0,
+                   help="micro-batcher deadline: flush once the oldest "
+                        "pending query has waited this long")
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--buckets", default=None,
+                   help="comma-separated padded batch-size buckets to "
+                        "pre-compile (default: doubling ladder up to "
+                        "max-batch)")
+    p.add_argument("--query-skew", type=float, default=0.0,
+                   help="Zipf exponent of the synthetic query distribution "
+                        "(0 = uniform)")
+    p.add_argument("--metrics-out", default=None, metavar="DIR",
+                   help="run-telemetry directory (sgcn_tpu.obs): manifest + "
+                        "serve/span events; render with "
+                        "scripts/obs_report.py")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    if not args.checkpoint and not args.random_init:
+        raise SystemExit("need --checkpoint CKPT or --random-init")
+    if args.checkpoint and args.random_init:
+        raise SystemExit("--checkpoint and --random-init are exclusive")
+
+    if args.metrics_out:
+        import os
+        os.environ["SGCN_METRICS_OUT"] = args.metrics_out
+
+    from ..utils.backend import enable_tpu_async_collectives, use_cpu_devices
+    if args.backend == "cpu":
+        use_cpu_devices(args.nparts)
+    enable_tpu_async_collectives()
+
+    import numpy as np
+
+    from ..io.mtx import read_dense_features, read_mtx
+    from ..parallel.plan import build_comm_plan
+    from ..partition.emit import read_partvec, read_partvec_pickle
+    from ..prep import normalize_adjacency
+
+    feats = labels = None
+    if args.npz:
+        from ..io.datasets import load_npz_dataset
+        a, feats, labels = load_npz_dataset(args.npz)
+    elif args.adjacency:
+        a = read_mtx(args.adjacency)
+    else:
+        raise SystemExit("need -a/--adjacency or --npz")
+    if args.normalize:
+        a = normalize_adjacency(a)
+    n = a.shape[0]
+    try:
+        pv = read_partvec(args.partvec)
+    except (UnicodeDecodeError, ValueError):
+        pv = read_partvec_pickle(args.partvec)
+    if len(pv) != n:
+        raise SystemExit(f"partvec length {len(pv)} != n {n}")
+    k = args.nparts
+    if pv.max() >= k:
+        raise SystemExit(f"partvec references part {pv.max()} >= k {k}")
+
+    if args.features_mtx:
+        feats = read_dense_features(args.features_mtx)
+    f = feats.shape[1] if feats is not None else args.nfeatures
+    if feats is None:
+        # the trainer CLI's synthetic harness inputs (GPU/PGCN.py:186-192)
+        feats = np.tile(np.arange(n, dtype=np.float32)[:, None], (1, f))
+
+    # model config: checkpoint provenance wins; CLI flags fill the gaps.
+    # activation comes ONLY from provenance — it is part of the served
+    # function (same params, different activation = different logits), and
+    # the engine re-verifies it against the checkpoint at load
+    model, widths = args.model, None
+    activation = final_activation = None
+    if args.checkpoint:
+        from ..utils.checkpoint import read_checkpoint_meta
+        meta = read_checkpoint_meta(args.checkpoint)
+        cfg = meta.get("model_config") or {}
+        model = model or cfg.get("model")
+        activation = cfg.get("activation")
+        final_activation = cfg.get("final_activation")
+        if cfg.get("widths"):
+            widths = list(cfg["widths"])
+        if cfg.get("fin") is not None and cfg["fin"] != f:
+            raise SystemExit(
+                f"checkpoint was trained on fin={cfg['fin']} features, "
+                f"this dataset has {f}")
+    model = model or "gcn"
+    if widths is None:
+        nclasses = args.classes or (
+            int(labels.max()) + 1 if labels is not None else f)
+        hidden = args.hidden or f
+        widths = [hidden] * (args.nlayers - 1) + [nclasses]
+
+    plan = build_comm_plan(a, pv, k)
+    buckets = (tuple(int(b) for b in args.buckets.split(","))
+               if args.buckets else None)
+
+    from ..obs import RunRecorder
+    from .engine import ServeEngine
+    from .loadgen import run_loadgen, synthetic_query_ids
+
+    engine = ServeEngine(
+        plan, fin=f, widths=widths, model=model,
+        activation=activation,
+        final_activation=final_activation or "none",
+        comm_schedule=args.comm_schedule, halo_dtype=args.halo_dtype,
+        checkpoint=args.checkpoint, max_batch=args.max_batch,
+        buckets=buckets, latency_budget_ms=args.latency_budget_ms,
+        seed=args.seed)
+    engine.set_features(feats)
+
+    recorder = None
+    if args.metrics_out:
+        recorder = RunRecorder(args.metrics_out, config=vars(args),
+                               run_kind="serve")
+        recorder.set_plan(plan, partitioner={"partvec": args.partvec,
+                                             "k": k})
+        recorder.set_backend(engine.mesh)
+        engine.attach_recorder(recorder)
+
+    qids = synthetic_query_ids(n, args.queries, seed=args.seed,
+                               skew=args.query_skew)
+    mode = "open" if args.qps > 0 else "closed"
+    engine.warmup(qids)      # every bucket, outside the measured window
+    result = run_loadgen(engine, qids,
+                         offered_qps=args.qps if args.qps > 0 else None)
+    engine.record_window(result, offered_qps=args.qps or None, mode=mode)
+
+    report = {
+        "metric": "serve_qps",
+        "value": result.summary()["achieved_qps"],
+        "unit": "qps",
+        "mode": mode,
+        "offered_qps": args.qps or None,
+        # live host-clock measurement from THIS process — the same
+        # provenance contract as the bench epoch times
+        "measured": True,
+        **result.summary(),
+        "deadline_flushes": engine.batcher.deadline_flushes,
+        "full_flushes": engine.batcher.full_flushes,
+        "latency_budget_ms": args.latency_budget_ms,
+        "model": model,
+        "widths": widths,
+        "weights": ("checkpoint" if args.checkpoint else "random-init"),
+        **engine.gauges(),
+    }
+    if recorder is not None:
+        recorder.record_summary(report)
+        recorder.close()
+    print(json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

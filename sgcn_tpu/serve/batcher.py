@@ -1,0 +1,130 @@
+"""Latency-budgeted dynamic micro-batching with pre-compiled size buckets.
+
+Two flush triggers, whichever fires first (the classic serving trade:
+batching amortizes the per-dispatch cost, the deadline bounds what any one
+query waits):
+
+  * **max-batch** — ``submit`` returns the flushed batch the moment it holds
+    ``max_batch`` queries;
+  * **deadline** — ``poll(now)`` returns the pending batch once the OLDEST
+    pending query has waited ``latency_budget_ms`` (age of the head of the
+    queue, not the mean: the budget is a per-query promise).
+
+Shapes under jit are static, so a variable-size batch would recompile the
+forward per distinct size — the engine instead pre-compiles a small ladder
+of padded ``buckets`` (doubling up to ``max_batch`` by default) and every
+flush is padded UP to the smallest covering bucket (``bucket_for``).  No
+query count can therefore trigger a compile after warm-up; the engine's
+``compile_count`` gauge and ``tests/test_serve.py`` hold that contract.
+
+The clock is injected (``clock=``) so deadline behavior is deterministically
+testable; nothing here touches jax.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+def default_buckets(max_batch: int) -> tuple[int, ...]:
+    """Doubling bucket ladder 1, 2, 4, … capped and terminated at
+    ``max_batch`` — log₂(max_batch) compiled programs cover every batch
+    size with ≤ 2× padding."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+@dataclass
+class Pending:
+    """One queued query: global vertex id + the arrival time its latency is
+    measured from."""
+
+    qid: int
+    t_arrival: float
+
+
+@dataclass
+class MicroBatcher:
+    """See module docstring.  ``buckets`` must cover ``max_batch``."""
+
+    max_batch: int = 64
+    latency_budget_ms: float = 50.0
+    buckets: tuple = None
+    clock: object = time.monotonic
+    # flush counters — the serve event's batching gauges
+    full_flushes: int = 0
+    deadline_flushes: int = 0
+    _pending: list = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.buckets is None:
+            self.buckets = default_buckets(self.max_batch)
+        self.buckets = tuple(sorted(int(b) for b in self.buckets))
+        if any(b < 1 for b in self.buckets):
+            raise ValueError(f"buckets must be positive: {self.buckets}")
+        if self.buckets[-1] < self.max_batch:
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} below max_batch "
+                f"{self.max_batch} — a full flush would have no compiled "
+                "program to run on")
+        if self.latency_budget_ms < 0:
+            raise ValueError(
+                f"latency_budget_ms must be >= 0, got "
+                f"{self.latency_budget_ms}")
+
+    def bucket_for(self, nqueries: int) -> int:
+        """Smallest pre-compiled bucket covering ``nqueries``."""
+        for b in self.buckets:
+            if b >= nqueries:
+                return b
+        raise ValueError(
+            f"batch of {nqueries} exceeds the largest bucket "
+            f"{self.buckets[-1]} (max_batch {self.max_batch})")
+
+    def submit(self, qid: int, t_arrival: float | None = None):
+        """Queue one query; returns the flushed batch (list of ``Pending``)
+        when this submit fills ``max_batch``, else ``None``."""
+        t = self.clock() if t_arrival is None else float(t_arrival)
+        self._pending.append(Pending(int(qid), t))
+        if len(self._pending) >= self.max_batch:
+            self.full_flushes += 1
+            return self._take()
+        return None
+
+    def next_deadline(self) -> float | None:
+        """Absolute clock time the pending head's budget expires (None when
+        nothing is pending) — what a loadgen sleeps toward."""
+        if not self._pending:
+            return None
+        return self._pending[0].t_arrival + self.latency_budget_ms / 1e3
+
+    def poll(self, now: float | None = None):
+        """Deadline flush: the pending batch once the oldest query's wait
+        reaches the budget, else ``None``."""
+        if not self._pending:
+            return None
+        now = self.clock() if now is None else float(now)
+        if now >= self.next_deadline():
+            self.deadline_flushes += 1
+            return self._take()
+        return None
+
+    def flush(self):
+        """Unconditional drain (end of a traffic window); ``None`` if empty.
+        Not a deadline flush — counters stay untouched."""
+        return self._take() if self._pending else None
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def _take(self):
+        out, self._pending = self._pending, []
+        return out

@@ -1,0 +1,335 @@
+"""AOT-compiled partitioned inference engine (forward-only, no VJP).
+
+The serving counterpart of ``train.fullbatch``: load a checkpoint and a
+``CommPlan``, verify provenance (plan digest + model config — a wrong-plan
+or wrong-config restore must fail at load, not as a deep tree-shape error
+or a cleanly-restored wrong model), and AOT-compile
+(``jax.jit(...).lower(...).compile()``, the trick ``FullBatchTrainer
+.lower_step`` already uses) ONE forward program per padded batch-size
+bucket.  No optimizer state, no gradient ring — the per-layer halo exchange
+is the ENTIRE comm cost, so the training transports transfer directly: the
+engine supports the same ``comm_schedule``/``halo_dtype`` levers, resolved
+through the SAME ``resolve_forward_setup`` the trainer uses (that shared
+resolver is what makes the served logits f32-bit-identical to the trainer's
+``evaluate()`` — tier-1-pinned by ``tests/test_serve.py``).
+
+Query path per micro-batch (host stages spanned via ``SpanTimer``, the
+schema-v2 machinery):
+
+  * ``serve:route``          — global vertex ids → (owner, local slot)
+    through the ``VertexRouter``;
+  * ``serve:batch``          — pad the batch up to its compiled bucket
+    (owner −1 on padding: matches no chip, contributes zero);
+  * ``serve:compile_lookup`` — fetch the bucket's AOT executable (a MISS
+    compiles and bumps ``compile_count`` — steady-state traffic must never
+    miss, the no-recompile contract);
+  * ``serve:forward``        — run the program and block on the replicated
+    ``(Q, nout)`` result.  The halo exchange executes INSIDE this one XLA
+    program, so it cannot carry its own measured span — it is attributed
+    analytically instead (``halo_*`` fields of ``gauges()``, the same
+    measured-vs-analytic discipline as ``docs/observability.md``).
+
+In-program query gather: each chip ``take``s its local logits rows for the
+whole padded query vector, masks to the queries it owns, and one ``psum``
+replicates the summed result — exact in f32 (every non-owner contributes
+literal zeros), one tiny collective per batch instead of shipping the full
+``(k, B, nout)`` logits to the host.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..parallel.mesh import AXIS, make_mesh_1d, replicate, shard_stacked
+from ..utils.timers import PhaseTimer
+from .batcher import MicroBatcher, default_buckets
+from .router import VertexRouter
+
+# host-side stages of one served micro-batch, in order — the span names the
+# engine emits (docs/serving.md glossary)
+SERVE_STAGES = ("serve:route", "serve:batch", "serve:compile_lookup",
+                "serve:forward")
+
+
+class ServeEngine:
+    """Forward-only partitioned inference over one plan + checkpoint."""
+
+    def __init__(
+        self,
+        plan,
+        fin: int,
+        widths: list[int],
+        model: str = "gcn",
+        activation: str | None = None,
+        final_activation: str = "none",
+        comm_schedule: str | None = None,
+        halo_dtype: str | None = None,
+        mesh=None,
+        params=None,
+        checkpoint: str | None = None,
+        max_batch: int = 64,
+        buckets: tuple | None = None,
+        latency_budget_ms: float = 50.0,
+        seed: int = 0,
+        precompile: bool = True,
+    ):
+        if halo_dtype is not None and model != "gcn":
+            raise ValueError(
+                "halo_dtype is a GCN wire lever; the GAT exchange ships "
+                "attention tables (same rule as the trainer)")
+        from ..train.fullbatch import resolve_forward_setup
+
+        self.plan = plan
+        self.fin = int(fin)
+        self.widths = list(widths)
+        self.model = model
+        # PGAT semantics: bare stacked modules, no inter-layer activation —
+        # the trainer CLI's default; parity with evaluate() needs the same
+        self.activation = activation if activation is not None else (
+            "none" if model == "gat" else "relu")
+        self.final_activation = final_activation
+        self.halo_dtype = halo_dtype
+        self.setup = resolve_forward_setup(
+            plan, fin, widths, model=model, comm_schedule=comm_schedule)
+        self.comm_schedule = self.setup.comm_schedule
+        self.comm_decision = self.setup.decision
+        self.mesh = mesh if mesh is not None else make_mesh_1d(plan.k)
+        self.router = VertexRouter(plan)
+        self.batcher = MicroBatcher(
+            max_batch=max_batch,
+            latency_budget_ms=latency_budget_ms,
+            buckets=buckets if buckets is not None
+            else default_buckets(max_batch))
+        self.recorder = None
+        self.timer = PhaseTimer()
+        from ..obs.tracing import SpanTimer
+        self.spans = SpanTimer(timer=self.timer)
+
+        # ---- params: checkpoint (provenance-verified) or given/fresh init
+        dims = list(zip([fin] + self.widths[:-1], self.widths))
+        if checkpoint is not None:
+            params = self._load_params(checkpoint, dims)
+        elif params is None:
+            import jax
+            params = self.setup.init_fn(jax.random.PRNGKey(seed), dims)
+        self.params = replicate(self.mesh, params)
+        self.pa = shard_stacked(self.mesh, self.setup.ship_arrays(plan))
+        self._h0 = None                    # set_features()
+        self._compiled: dict[int, object] = {}   # bucket size → executable
+        self.compile_count = 0
+        if precompile:
+            for b in self.batcher.buckets:
+                self._ensure_compiled(b)
+
+    # ------------------------------------------------------------- loading
+    def _load_params(self, path: str, dims):
+        """Restore the params tree (opt state skipped — inference has none)
+        from a trainer checkpoint, verifying plan digest + model config
+        FIRST so a wrong-plan/model restore fails with a clear message."""
+        import jax
+
+        from ..utils.checkpoint import (load_checkpoint_leaves,
+                                        verify_checkpoint_provenance)
+        leaves, meta = load_checkpoint_leaves(path)
+        verify_checkpoint_provenance(
+            meta, plan=self.plan, model=self.model, fin=self.fin,
+            widths=self.widths, activation=self.activation,
+            final_activation=self.final_activation,
+            what=f"serve engine ({path!r})")
+        template = self.setup.init_fn(jax.random.PRNGKey(0), dims)
+        tleaves, treedef = jax.tree.flatten(template)
+        if len(leaves) < len(tleaves):
+            raise ValueError(
+                f"checkpoint {path!r} has {len(leaves)} leaves, the "
+                f"{self.model} params tree needs {len(tleaves)} — not a "
+                "checkpoint of this model config")
+        # (params, opt_state) flattens params-first; the leading leaves ARE
+        # the params in tree order
+        got = leaves[: len(tleaves)]
+        for have, want in zip(got, tleaves):
+            if tuple(have.shape) != tuple(np.shape(want)):
+                raise ValueError(
+                    f"checkpoint param leaf shape {have.shape} != expected "
+                    f"{np.shape(want)} — wrong fin/widths for this "
+                    "checkpoint (read_checkpoint_meta shows its config)")
+        self.checkpoint_meta = meta
+        return jax.tree.unflatten(treedef, got)
+
+    # ------------------------------------------------------------ features
+    def set_features(self, features: np.ndarray) -> None:
+        """Scatter + shard the global ``(n, fin)`` feature rows once — the
+        serving working set every forward reads (features are part of the
+        model's input, not of a query)."""
+        features = np.asarray(features, dtype=np.float32)
+        if features.shape != (self.plan.n, self.fin):
+            raise ValueError(
+                f"features shape {features.shape} != "
+                f"({self.plan.n}, {self.fin})")
+        h0 = self.plan.scatter_rows(features)
+        self._h0 = shard_stacked(self.mesh, h0)
+
+    # ------------------------------------------------------------- compile
+    def _build(self, q: int):
+        """AOT-compile the bucket-``q`` forward+gather program."""
+        import jax
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        import jax.numpy as jnp
+
+        fwd = self.setup.forward_fn
+        fwd_static = self.setup.fwd_static
+        extra = ({"halo_dtype": self.halo_dtype}
+                 if self.halo_dtype is not None else {})
+        symmetric = self.plan.symmetric
+
+        def per_chip(params, pa, h0, q_owner, q_local):
+            pa = jax.tree.map(lambda x: x[0], pa)
+            h0 = h0[0]
+            logits = fwd(
+                params, h0, pa,
+                activation=self.activation,
+                final_activation=self.final_activation,
+                symmetric=symmetric,
+                **fwd_static, **extra,
+            ).astype("float32")
+            sel = jnp.take(logits, q_local, axis=0)        # (Q, nout)
+            mine = (q_owner == lax.axis_index(AXIS)).astype(
+                jnp.float32)[:, None]
+            # non-owners contribute exact zeros, so the psum'd row IS the
+            # owner's f32 logits row bit-for-bit
+            return lax.psum(sel * mine, AXIS)
+
+        smapped = jax.shard_map(
+            per_chip,
+            mesh=self.mesh,
+            in_specs=(P(), P(AXIS), P(AXIS), P(), P()),
+            out_specs=P(),
+        )
+        rep = NamedSharding(self.mesh, P())
+        shd = NamedSharding(self.mesh, P(AXIS))
+        params_s = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep),
+            self.params)
+        pa_s = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=shd),
+            self.pa)
+        h0_s = jax.ShapeDtypeStruct((self.plan.k, self.plan.b, self.fin),
+                                    np.dtype(np.float32), sharding=shd)
+        qs = jax.ShapeDtypeStruct((q,), np.dtype(np.int32), sharding=rep)
+        lowered = jax.jit(smapped).lower(params_s, pa_s, h0_s, qs, qs)
+        return lowered.compile()
+
+    def _ensure_compiled(self, q: int):
+        if q not in self._compiled:
+            self._compiled[q] = self._build(q)
+            self.compile_count += 1
+        return self._compiled[q]
+
+    # --------------------------------------------------------------- query
+    def query(self, qids) -> np.ndarray:
+        """Serve one micro-batch of global vertex ids → ``(len(qids), nout)``
+        f32 logits.  Stages are spanned (``SERVE_STAGES``); the batch is
+        padded to its bucket so no size triggers a recompile."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._h0 is None:
+            raise ValueError(
+                "no features loaded — call set_features(features) before "
+                "serving queries")
+        qids = np.asarray(qids, dtype=np.int64).reshape(-1)
+        nq = len(qids)
+        if nq == 0:
+            return np.zeros((0, self.widths[-1]), np.float32)
+        with self.spans.span("serve:route"):
+            owners, locals_ = self.router.lookup(qids)
+        with self.spans.span("serve:batch"):
+            bucket = self.batcher.bucket_for(nq)
+            q_owner = np.full(bucket, -1, np.int32)    # pad: matches no chip
+            q_local = np.zeros(bucket, np.int32)
+            q_owner[:nq] = owners
+            q_local[:nq] = locals_
+            rep = NamedSharding(self.mesh, P())
+            q_owner = jax.device_put(q_owner, rep)
+            q_local = jax.device_put(q_local, rep)
+        with self.spans.span("serve:compile_lookup"):
+            prog = self._ensure_compiled(bucket)
+        with self.spans.span("serve:forward"):
+            out = prog(self.params, self.pa, self._h0, q_owner, q_local)
+            out = np.asarray(out)                      # readback = sync
+        return out[:nq]
+
+    def warmup(self, qids) -> None:
+        """Serve one throwaway batch per pre-compiled bucket (cycling
+        ``qids`` to fill each).  A bucket's FIRST dispatch pays runtime
+        autotuning even with an AOT program, and deadline flushes land on
+        the small buckets — run this before a measured window or the
+        overhead lands in the published p99."""
+        qids = np.asarray(qids, dtype=np.int64).reshape(-1)
+        if qids.size == 0:
+            raise ValueError("warmup needs at least one query id")
+        for b in self.batcher.buckets:
+            self.query(np.resize(qids, b))
+
+    # -------------------------------------------------------------- gauges
+    @property
+    def nlayers(self) -> int:
+        return len(self.widths)
+
+    def gauges(self) -> dict:
+        """Analytic per-batch/per-query exchange gauges of the serving
+        forward — plan-derived, deterministic (zero-band in the bench trend).
+        The forward runs ``nlayers`` exchanges per micro-batch regardless of
+        batch size, so the steady-state per-QUERY wire cost is the full-
+        batch amortization ``nlayers · wire_rows/exchange ÷ max_batch``."""
+        wire = self.plan.wire_rows_per_exchange(self.comm_schedule)
+        true = int(self.plan.predicted_send_volume.sum())
+        return {
+            "comm_schedule": self.comm_schedule,
+            "exchanges_per_batch": self.nlayers,
+            "wire_rows_per_exchange": wire,
+            "true_rows_per_exchange": true,
+            "wire_rows_per_batch": self.nlayers * wire,
+            "wire_rows_per_query": round(
+                self.nlayers * wire / self.batcher.max_batch, 6),
+            "buckets": list(self.batcher.buckets),
+            "compiles": self.compile_count,
+        }
+
+    # ------------------------------------------------------------ recorder
+    def attach_recorder(self, recorder) -> None:
+        """Attach a ``RunRecorder``: stage spans become schema events and
+        the transport decision lands in the manifest (the same
+        reconstructibility contract as the trainers)."""
+        self.recorder = recorder
+        self.spans.recorder = recorder
+        if self.comm_decision:
+            recorder.set_comm_schedule(self.comm_decision)
+
+    def record_window(self, result, offered_qps: float | None = None,
+                      mode: str = "open") -> None:
+        """Emit one schema-v3 ``serve`` event for a completed traffic
+        window (``loadgen.ServeResult``) with the batching counters and the
+        analytic wire gauge riding along."""
+        if self.recorder is None:
+            return
+        g = self.gauges()
+        self.recorder.record_serve(
+            queries=result.queries,
+            achieved_qps=result.achieved_qps,
+            latency_p50_ms=result.p50_ms,
+            latency_p95_ms=result.p95_ms,
+            latency_p99_ms=result.p99_ms,
+            window_s=result.window_s,
+            offered_qps=offered_qps,
+            mode=mode,
+            batches=result.batches,
+            mean_batch=result.mean_batch,
+            deadline_flushes=self.batcher.deadline_flushes,
+            full_flushes=self.batcher.full_flushes,
+            latency_budget_ms=self.batcher.latency_budget_ms,
+            compiles=self.compile_count,
+            buckets=list(self.batcher.buckets),
+            comm_schedule=self.comm_schedule,
+            wire_rows_per_query=g["wire_rows_per_query"],
+        )

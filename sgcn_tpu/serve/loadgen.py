@@ -1,0 +1,146 @@
+"""Synthetic closed/open-loop query traffic over a ``ServeEngine``.
+
+Two generator modes, the standard serving-bench pair:
+
+  * **open loop** (``offered_qps > 0``): queries arrive on a fixed schedule
+    ``t_i = t0 + i/qps`` regardless of how fast the server drains them — the
+    honest overload model (a slow server accumulates queue delay instead of
+    silently throttling its own offered load).  Latency is measured from the
+    SCHEDULED arrival, so queue time counts.
+  * **closed loop** (``offered_qps`` None/0): the next query is submitted as
+    soon as the batcher accepts it — the saturation probe; achieved QPS is
+    then the engine's ceiling at this batch shape.
+
+The loop drives the ``MicroBatcher`` exactly as a server would: submit on
+arrival, execute on a max-batch flush, and sleep toward whichever comes
+first of the next arrival and the pending head's deadline.  The tail is
+mode-split: an OPEN-loop tail still honors the latency budget (a real
+server cannot know the trace ended, so the pending batch deadline-flushes
+like any other), while a CLOSED-loop tail drains immediately with an
+ordinary flush (the generator knows no further query is coming, so waiting
+out the budget would only deflate the ceiling QPS and inflate p99).
+Clock/sleep are injectable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+def synthetic_query_ids(n: int, count: int, seed: int = 0,
+                        skew: float = 0.0) -> np.ndarray:
+    """``count`` query vertex ids over ``[0, n)``.  ``skew=0`` is uniform;
+    ``skew>0`` draws from a Zipf-like power law over a random vertex
+    permutation (real serving traffic concentrates on hub entities — the
+    skewed mode exercises co-location batching)."""
+    rng = np.random.default_rng(seed)
+    if skew <= 0:
+        return rng.integers(0, n, size=count, dtype=np.int64)
+    ranks = rng.permutation(n)
+    weights = 1.0 / np.arange(1, n + 1, dtype=np.float64) ** skew
+    weights /= weights.sum()
+    return ranks[rng.choice(n, size=count, p=weights)].astype(np.int64)
+
+
+@dataclass
+class ServeResult:
+    """Measured outcome of one traffic window."""
+
+    latencies_ms: list = field(default_factory=list)
+    window_s: float = 0.0
+    batches: int = 0
+    batch_sizes: list = field(default_factory=list)
+
+    @property
+    def queries(self) -> int:
+        return len(self.latencies_ms)
+
+    @property
+    def achieved_qps(self) -> float:
+        return self.queries / self.window_s if self.window_s > 0 else 0.0
+
+    @property
+    def mean_batch(self) -> float:
+        return (sum(self.batch_sizes) / len(self.batch_sizes)
+                if self.batch_sizes else 0.0)
+
+    def _pct(self, p: float) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        return float(np.percentile(np.asarray(self.latencies_ms), p))
+
+    @property
+    def p50_ms(self) -> float:
+        return self._pct(50)
+
+    @property
+    def p95_ms(self) -> float:
+        return self._pct(95)
+
+    @property
+    def p99_ms(self) -> float:
+        return self._pct(99)
+
+    def summary(self) -> dict:
+        return {
+            "queries": self.queries,
+            "window_s": round(self.window_s, 6),
+            "achieved_qps": round(self.achieved_qps, 3),
+            "latency_p50_ms": round(self.p50_ms, 3),
+            "latency_p95_ms": round(self.p95_ms, 3),
+            "latency_p99_ms": round(self.p99_ms, 3),
+            "batches": self.batches,
+            "mean_batch": round(self.mean_batch, 3),
+        }
+
+
+def run_loadgen(engine, qids, offered_qps: float | None = None,
+                clock=time.monotonic, sleep=time.sleep) -> ServeResult:
+    """Drive ``engine`` (and its batcher) through ``qids``; see module
+    docstring for the open/closed-loop semantics."""
+    qids = np.asarray(qids, dtype=np.int64).reshape(-1)
+    batcher = engine.batcher
+    res = ServeResult()
+    t0 = clock()
+
+    def execute(batch):
+        if not batch:
+            return
+        engine.query([p.qid for p in batch])
+        done = clock()
+        for p in batch:
+            res.latencies_ms.append((done - p.t_arrival) * 1e3)
+        res.batches += 1
+        res.batch_sizes.append(len(batch))
+
+    i = 0
+    total = len(qids)
+    while i < total or len(batcher):
+        now = clock()
+        next_arrival = (t0 + i / offered_qps if (offered_qps and i < total)
+                        else (now if i < total else None))
+        deadline = batcher.next_deadline()
+        if next_arrival is not None and (deadline is None
+                                         or next_arrival <= deadline):
+            if next_arrival > now:
+                sleep(next_arrival - now)
+            batch = batcher.submit(int(qids[i]), t_arrival=next_arrival)
+            i += 1
+            execute(batch)
+        elif deadline is not None and offered_qps:
+            # open-loop tail (or an arrival gap): the budget is still the
+            # flush trigger — the server cannot know the trace ended
+            if deadline > now:
+                sleep(deadline - now)
+            execute(batcher.poll(clock()))
+        elif deadline is not None:
+            # closed-loop tail: no future arrival can fill the batch, so
+            # drain now (ordinary flush — not a deadline miss)
+            execute(batcher.flush())
+        else:                            # i == total, nothing pending
+            break
+    res.window_s = clock() - t0
+    return res

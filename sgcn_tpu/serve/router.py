@@ -1,0 +1,60 @@
+"""Vertex router: query vertex ids → owning partition + local slot.
+
+The serving analogue of the trainers' data-placement step: a query names a
+GLOBAL vertex id, but logits live sharded per chip under the plan's vertex
+relabeling (``CommPlan.owner`` / ``CommPlan.local_idx`` — the same arrays
+``scatter_rows``/``gather_rows`` ride).  The router resolves that mapping on
+the host and validates ids loudly.  ``route`` additionally groups queries by
+owning chip — a diagnostic today (the engine's full-graph forward serves
+every batch through all k chips regardless of ownership) and the grouping
+primitive for the ROADMAP's phase-2 sub-graph forwards, where chip-local
+packing starts to pay.
+
+The gather itself happens IN the compiled forward program (each chip selects
+its own queries and a psum replicates the result — ``engine.py``), so the
+router's output is indices, never feature rows.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# CommPlan fields the serve subsystem reads for routing — declared as a
+# consumer tuple like the model PLAN_FIELDS so the plan-contract lint
+# (tests/test_plan_contract.py) covers the serve engine from day one.  Both
+# are GLOBAL vertex-indexed arrays (never per-chip-stacked): the router runs
+# on the host over the full square plan.
+SERVE_ROUTER_FIELDS = ("owner", "local_idx")
+
+
+class VertexRouter:
+    """Owner/slot lookup + co-location grouping over one ``CommPlan``."""
+
+    def __init__(self, plan):
+        self.n = int(plan.n)
+        self.k = int(plan.k)
+        self.owner = np.asarray(plan.owner, dtype=np.int32)
+        self.local_idx = np.asarray(plan.local_idx, dtype=np.int32)
+
+    def lookup(self, qids) -> tuple[np.ndarray, np.ndarray]:
+        """``(owners, locals)`` for a batch of global vertex ids; raises on
+        out-of-range ids (a bad query must fail at the router, not as a
+        wrong-row gather deep inside the compiled program)."""
+        q = np.asarray(qids, dtype=np.int64).reshape(-1)
+        if q.size and (q.min() < 0 or q.max() >= self.n):
+            bad = q[(q < 0) | (q >= self.n)][:5]
+            raise ValueError(
+                f"query vertex ids out of range [0, {self.n}): {bad.tolist()}")
+        return self.owner[q], self.local_idx[q]
+
+    def route(self, qids) -> dict[int, np.ndarray]:
+        """Group a batch of query ids by owning partition; chips with no
+        queries are absent.  See the module docstring for where this is
+        (and is not yet) load-bearing."""
+        q = np.asarray(qids, dtype=np.int64).reshape(-1)
+        owners, _ = self.lookup(q)
+        order = np.argsort(owners, kind="stable")
+        out: dict[int, np.ndarray] = {}
+        for chip in np.unique(owners):
+            out[int(chip)] = q[order][owners[order] == chip]
+        return out

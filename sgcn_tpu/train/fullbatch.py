@@ -74,6 +74,113 @@ LOSSES = {
 
 
 @dataclass
+class ForwardSetup:
+    """Resolved forward configuration — the ONE model/schedule/aggregator
+    selection shared by the trainer and the serve engine
+    (``sgcn_tpu/serve/engine.py``).  Keeping a single resolver is what makes
+    the serve engine's forward program the SAME program the trainer's
+    ``evaluate()`` compiles (bit-identical f32 logits, tier-1-pinned by
+    ``tests/test_serve.py``) — a second copy of the selection rules would
+    drift on exactly the branch parity depends on (Pallas auto-select,
+    ragged field tuples, GAT table forms)."""
+
+    model: str
+    comm_schedule: str            # resolved: 'a2a' or 'ragged', never 'auto'
+    plan_fields: tuple            # CommPlan array fields the forward ships
+    fwd_static: dict              # static kwargs of the forward fn
+    forward_fn: object            # per-chip forward (MODELS registry)
+    init_fn: object               # param init (MODELS registry)
+    decision: dict                # resolve_comm_schedule's selection log
+
+    def ship_arrays(self, plan) -> dict:
+        """The plan arrays the forward consumes, ready to shard — including
+        the GAT int8 edge-mask narrowing (attention ignores Â's values, and
+        the f32 forms are ~0.6 GB of per-chip arguments at products scale)."""
+        arrays = _plan_arrays(plan, self.plan_fields)
+        if self.model == "gat":
+            # mask on w != 0: plan padding carries weight exactly 0 by
+            # construction, so every real edge survives even for a signed/
+            # unnormalized weighted graph (ADVICE r4 — `> 0` dropped
+            # negative-weight edges)
+            for f in ("cell_w", "ctail_w"):
+                arrays[f] = (arrays[f] != 0).astype(np.int8)
+        return arrays
+
+
+def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
+                          model: str = "gcn",
+                          comm_schedule: str | None = None,
+                          compute_dtype: str | None = None,
+                          halo_staleness: int = 0) -> ForwardSetup:
+    """Resolve (schedule, shipped plan fields, static forward kwargs) for one
+    plan — the selection logic that used to live inline in
+    ``FullBatchTrainer.__init__``, factored out so the forward-only serve
+    engine rides the identical rules.  Builds the lazy plan layouts the
+    selection needs (``ensure_ragged``, ``ensure_cell``,
+    ``ensure_pallas_tiles``) as side effects, exactly as the trainer did."""
+    from ..parallel.plan import resolve_comm_schedule
+
+    decision: dict = {}
+    init_fn, forward_fn, fields_fn, static_fn = MODELS[model]
+    comm_schedule = resolve_comm_schedule(
+        comm_schedule, [plan], model, halo_staleness,
+        fin=fin, widths=list(widths), compute_dtype=compute_dtype,
+        decision=decision)
+    if comm_schedule == "ragged":
+        if not plan.symmetric:
+            raise ValueError(
+                "comm_schedule='ragged' uses the symmetric custom "
+                "backward (the gradient rides the same ppermute ring); "
+                "this plan is asymmetric — run the a2a schedule")
+        plan.ensure_ragged()
+    plan_fields = fields_fn(plan)
+    fwd_static = static_fn(plan)
+    if model == "gcn" and comm_schedule == "ragged":
+        # the ragged schedule stays on the ELL aggregator (its fold
+        # contract is built around the per-owner edge split; the Pallas
+        # tile layout is a dense-a2a companion) — mirror of the stale
+        # mode's aggregator pin below.  The composed (stale × ragged)
+        # step ships the same ring arrays under its own contract tuple.
+        from ..models.gcn import GCN_PLAN_FIELDS_RAGGED
+        from ..parallel.plan import STALE_PLAN_FIELDS_RAGGED
+        plan_fields = (STALE_PLAN_FIELDS_RAGGED if halo_staleness
+                       else GCN_PLAN_FIELDS_RAGGED)
+        fwd_static = {"ell_buckets": plan.ell_buckets,
+                      "comm_schedule": "ragged",
+                      "rr_sizes": plan.rr_sizes,
+                      "rr_edge_sizes": plan.rr_edge_sizes}
+    if model == "gcn" and not halo_staleness and comm_schedule == "a2a":
+        # plan-driven kernel choice (VERDICT r3 #9): per-chip tables in
+        # the VMEM regime switch the aggregator to the Pallas kernel.
+        # The stale mode stays on the ELL aggregator: pspmm_stale's
+        # carry contract is built around it, and hiding the exchange
+        # removes the latency the VMEM kernel would have overlapped.
+        from ..ops.pallas_spmm import PALLAS_PLAN_FIELDS, use_pallas_spmm
+        if use_pallas_spmm(plan, fin, widths):
+            plan.ensure_pallas_tiles()
+            plan_fields = PALLAS_PLAN_FIELDS
+            fwd_static = {
+                "pallas_tb": plan.pallas_tb,
+                "pallas_emulate": jax.default_backend() != "tpu",
+            }
+    if model == "gat" and comm_schedule == "ragged":
+        # the attention tables ride the plan's model-independent
+        # per-vertex ring layout (rsend_idx/rhalo_dst); the combined
+        # bucketed slot passes are schedule-blind, so only the shipped
+        # exchange arrays and the static ring spec change
+        from ..models.gat import GAT_PLAN_FIELDS_RAGGED
+        plan_fields = GAT_PLAN_FIELDS_RAGGED
+        fwd_static = dict(fwd_static,
+                          comm_schedule="ragged",
+                          rr_sizes=plan.rr_sizes,
+                          halo_r=plan.r)
+    return ForwardSetup(model=model, comm_schedule=comm_schedule,
+                        plan_fields=plan_fields, fwd_static=fwd_static,
+                        forward_fn=forward_fn, init_fn=init_fn,
+                        decision=decision)
+
+
+@dataclass
 class TrainData:
     """Stacked per-chip training data (leading axis k, sharded over the mesh)."""
 
@@ -297,27 +404,19 @@ class FullBatchTrainer:
                     "halo_staleness=1 is defined for the f32 non-remat "
                     "trainer (carries are f32 state threaded through the "
                     "step); drop compute_dtype/remat or run exact mode")
-        # ONE selection rule for both trainers (parallel/plan.py): 'auto'
-        # silently prefers ragged on skewed plans unless that forfeits the
-        # Pallas VMEM aggregator; an explicit 'ragged' is a contract,
-        # validated loudly below
-        from ..parallel.plan import resolve_comm_schedule
-        self.comm_decision: dict = {}   # selection inputs → run manifest
-        comm_schedule = resolve_comm_schedule(
-            comm_schedule, [plan], model, halo_staleness,
-            fin=fin, widths=list(widths), compute_dtype=compute_dtype,
-            decision=self.comm_decision)
-        if comm_schedule == "ragged":
-            if not plan.symmetric:
-                raise ValueError(
-                    "comm_schedule='ragged' uses the symmetric custom "
-                    "backward (the gradient rides the same ppermute ring); "
-                    "this plan is asymmetric — run the a2a schedule")
-            # composition with halo_staleness=1 is SUPPORTED (the round-
-            # structured carry of pspmm_stale_ragged); the staleness gates
-            # above (GCN, symmetric, f32 non-remat) already cover the
-            # genuinely unsupported combos
-            plan.ensure_ragged()
+        # ONE selection rule for both trainers AND the serve engine
+        # (resolve_forward_setup → parallel/plan.py::resolve_comm_schedule):
+        # 'auto' silently prefers ragged on skewed plans unless that
+        # forfeits the Pallas VMEM aggregator; an explicit 'ragged' is a
+        # contract, validated loudly inside the resolver.  Composition with
+        # halo_staleness=1 is SUPPORTED (the round-structured carry of
+        # pspmm_stale_ragged); the staleness gates above (GCN, symmetric,
+        # f32 non-remat) already cover the genuinely unsupported combos.
+        setup = resolve_forward_setup(
+            plan, fin, widths, model=model, comm_schedule=comm_schedule,
+            compute_dtype=compute_dtype, halo_staleness=halo_staleness)
+        self.comm_decision = setup.decision   # selection → run manifest
+        comm_schedule = setup.comm_schedule
         self.comm_schedule = comm_schedule
         self.halo_staleness = halo_staleness
         self.halo_delta = halo_delta
@@ -348,49 +447,9 @@ class FullBatchTrainer:
         self.final_activation = final_activation
         self.compute_dtype = compute_dtype
         self.remat = remat
-        init_fn, self._forward_fn, fields_fn, static_fn = MODELS[model]
-        self.plan_fields = fields_fn(plan)
-        self._fwd_static = static_fn(plan)   # e.g. the ELL bucket structure
-        if model == "gcn" and comm_schedule == "ragged":
-            # the ragged schedule stays on the ELL aggregator (its fold
-            # contract is built around the per-owner edge split; the Pallas
-            # tile layout is a dense-a2a companion) — mirror of the stale
-            # mode's aggregator pin below.  The composed (stale × ragged)
-            # step ships the same ring arrays under its own contract tuple.
-            from ..models.gcn import GCN_PLAN_FIELDS_RAGGED
-            from ..parallel.plan import STALE_PLAN_FIELDS_RAGGED
-            self.plan_fields = (STALE_PLAN_FIELDS_RAGGED if halo_staleness
-                                else GCN_PLAN_FIELDS_RAGGED)
-            self._fwd_static = {"ell_buckets": plan.ell_buckets,
-                                "comm_schedule": "ragged",
-                                "rr_sizes": plan.rr_sizes,
-                                "rr_edge_sizes": plan.rr_edge_sizes}
-        if model == "gcn" and not halo_staleness and comm_schedule == "a2a":
-            # plan-driven kernel choice (VERDICT r3 #9): per-chip tables in
-            # the VMEM regime switch the aggregator to the Pallas kernel.
-            # The stale mode stays on the ELL aggregator: pspmm_stale's
-            # carry contract is built around it, and hiding the exchange
-            # removes the latency the VMEM kernel would have overlapped.
-            from ..ops.pallas_spmm import (PALLAS_PLAN_FIELDS,
-                                           use_pallas_spmm)
-            if use_pallas_spmm(plan, fin, widths):
-                plan.ensure_pallas_tiles()
-                self.plan_fields = PALLAS_PLAN_FIELDS
-                self._fwd_static = {
-                    "pallas_tb": plan.pallas_tb,
-                    "pallas_emulate": jax.default_backend() != "tpu",
-                }
-        if model == "gat" and comm_schedule == "ragged":
-            # the attention tables ride the plan's model-independent
-            # per-vertex ring layout (rsend_idx/rhalo_dst); the combined
-            # bucketed slot passes are schedule-blind, so only the shipped
-            # exchange arrays and the static ring spec change
-            from ..models.gat import GAT_PLAN_FIELDS_RAGGED
-            self.plan_fields = GAT_PLAN_FIELDS_RAGGED
-            self._fwd_static = dict(self._fwd_static,
-                                    comm_schedule="ragged",
-                                    rr_sizes=plan.rr_sizes,
-                                    halo_r=plan.r)
+        init_fn, self._forward_fn = setup.init_fn, setup.forward_fn
+        self.plan_fields = setup.plan_fields
+        self._fwd_static = setup.fwd_static  # e.g. the ELL bucket structure
         if model == "gat":
             # pre-flight the measured single-chip capacity edge: a clear
             # error beats a compile OOM or a dead TPU worker — BOTH were
@@ -413,18 +472,7 @@ class FullBatchTrainer:
         self.params = replicate(self.mesh, self.params)
         self.opt_state = replicate(self.mesh, self.opt_state)
         self.last_err = None
-        arrays = _plan_arrays(plan, self.plan_fields)
-        if model == "gat":
-            # attention IGNORES Â's values (scores replace them), so the
-            # edge masks ship as int8 — the f32 forms are ~0.6 GB of
-            # per-chip arguments at products scale, part of the round-4 OOM
-            # margin.  Mask on w != 0: plan padding carries weight exactly 0
-            # by construction, so this keeps every real edge even for a
-            # signed/unnormalized weighted graph (ADVICE r4 — `> 0` silently
-            # dropped negative-weight edges).
-            for f in ("cell_w", "ctail_w"):
-                arrays[f] = (arrays[f] != 0).astype(np.int8)
-        self.pa = shard_stacked(self.mesh, arrays)
+        self.pa = shard_stacked(self.mesh, setup.ship_arrays(plan))
         # per-exchange wire lane widths (f32-lane equivalents) — the real
         # table widths each model ships, so the CommStats byte gauges
         # (halo_bytes_true/halo_bytes_wire) reconcile EXACTLY with the obs

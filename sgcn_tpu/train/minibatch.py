@@ -159,6 +159,12 @@ class MiniBatchTrainer:
             activation=activation, model=model, loss=loss,
             optimizer=optimizer, seed=seed,
             compute_dtype=compute_dtype, comm_schedule=comm_schedule)
+        # checkpoints save through `inner`, whose plan is a padded per-BATCH
+        # plan — its digest varies with batch_size/nbatches/pad envelope, so
+        # it is not a stable run identity; suppress it (utils/checkpoint.py
+        # honors the sentinel) rather than make every cross-batch-shape
+        # resume a digest error.  Model config is still recorded + verified.
+        self.inner.checkpoint_plan = None
         self.nlayers = len(widths)
         self._fullgraph_eval = None   # built lazily, cached across calls
         self.recorder = None          # run telemetry (sgcn_tpu.obs)

@@ -1,4 +1,4 @@
-"""Checkpoint / resume for trainer state.
+"""Checkpoint / resume for trainer state, with provenance.
 
 The reference has NO checkpointing (SURVEY.md §5.4): weights are re-randomized
 every run and only the offline partition artifacts act as a cache.  For long
@@ -7,16 +7,41 @@ checkpoint: all pytree leaves of (params, opt_state) plus a step counter in
 one ``.npz``, restored into the trainer's existing tree structure (which also
 re-applies the mesh sharding via device_put on assignment).
 
+Provenance (PR-8): ``save_checkpoint`` additionally records the comm plan's
+digest (``obs.recorder.plan_digest`` — the same 16-hex identity the run
+manifest carries) and the model config (model kind, input width, layer dims,
+activation/loss, the GAT fused-form mode) when the trainer exposes them.
+``load_checkpoint`` and the serve engine (``sgcn_tpu/serve/engine.py``)
+verify both and fail with a CLEAR message on mismatch — before provenance, a
+wrong-config restore either died deep inside tree-structure shape errors or,
+worse, a checkpoint from a DIFFERENT graph/run with coincidentally matching
+leaf shapes restored cleanly and served the wrong model.  Weights themselves
+are partition-independent (no leaf is vertex-indexed), so a deliberate
+same-graph re-partition restore stays possible: ``load_checkpoint(...,
+verify=False)``.  The mini-batch trainer suppresses the digest entirely
+(its inner plan is a per-batch plan, not a run identity — the
+``checkpoint_plan`` sentinel below).  Checkpoints written before this
+change carry no provenance and still load (nothing to verify).
+
 Works for any trainer exposing ``params`` / ``opt_state`` / ``mesh``
 (FullBatchTrainer, MiniBatchTrainer.inner).
 """
 
 from __future__ import annotations
 
+import json
+
 import jax
 import numpy as np
 
 from ..parallel.mesh import replicate
+
+# non-leaf keys the .npz may carry next to the ``leaf_<i>`` arrays — counting
+# leaves as ``len(files) - 1`` broke the moment a second metadata key landed,
+# so loaders count ``leaf_`` keys explicitly instead
+_META_STEP = "__step__"
+_META_DIGEST = "__plan_digest__"
+_META_MODEL = "__model_config__"
 
 
 def _norm(path: str) -> str:
@@ -24,25 +49,132 @@ def _norm(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
+def model_config_of(trainer) -> dict | None:
+    """The checkpoint's model-identity block, read off a trainer's attrs
+    (best-effort: a trainer that predates an attribute simply omits it).
+    ``gat_fused`` records the table-form lever (``$SGCN_GAT_FUSED``) the
+    params were trained under — the fused/split/packed forms share one param
+    tree, so it is provenance, not a load-blocking field."""
+    cfg = {}
+    for attr, key in (("model", "model"), ("fin", "fin"),
+                      ("widths", "widths"), ("activation", "activation"),
+                      ("final_activation", "final_activation"),
+                      ("loss_name", "loss")):
+        v = getattr(trainer, attr, None)
+        if v is not None:
+            cfg[key] = list(v) if key == "widths" else v
+    if cfg.get("model") == "gat":
+        import os
+        cfg["gat_fused"] = os.environ.get("SGCN_GAT_FUSED", "1")
+    return cfg or None
+
+
 def save_checkpoint(trainer, path: str, step: int = 0) -> str:
     leaves = jax.tree.leaves((trainer.params, trainer.opt_state))
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
-    arrays["__step__"] = np.asarray(step, dtype=np.int64)
+    arrays[_META_STEP] = np.asarray(step, dtype=np.int64)
+    # ``checkpoint_plan`` (may be explicitly None) overrides ``plan``: the
+    # mini-batch trainer checkpoints through its inner trainer, whose plan
+    # is a padded per-BATCH plan — its digest varies with batch_size/
+    # nbatches/pad envelope, so it is not a stable run identity and
+    # recording it would make every cross-batch-shape resume a digest error
+    plan = getattr(trainer, "checkpoint_plan", getattr(trainer, "plan", None))
+    if plan is not None:
+        from ..obs.recorder import plan_digest
+        arrays[_META_DIGEST] = np.asarray(plan_digest(plan))
+    cfg = model_config_of(trainer)
+    if cfg is not None:
+        arrays[_META_MODEL] = np.asarray(json.dumps(cfg))
     path = _norm(path)
     np.savez(path, **arrays)
     return path
 
 
-def load_checkpoint(trainer, path: str) -> int:
+def read_checkpoint_meta(path: str) -> dict:
+    """Provenance block of a checkpoint file: ``{step, plan_digest,
+    model_config, n_leaves}`` — digest/config ``None`` for pre-provenance
+    checkpoints.  Cheap (``np.load`` is lazy; only metadata arrays read)."""
+    with np.load(_norm(path)) as data:
+        return {
+            "step": int(data[_META_STEP]) if _META_STEP in data.files else 0,
+            "plan_digest": (str(data[_META_DIGEST].item())
+                            if _META_DIGEST in data.files else None),
+            "model_config": (json.loads(str(data[_META_MODEL].item()))
+                             if _META_MODEL in data.files else None),
+            "n_leaves": sum(1 for f in data.files if f.startswith("leaf_")),
+        }
+
+
+def verify_checkpoint_provenance(meta: dict, plan=None,
+                                 model: str | None = None,
+                                 fin: int | None = None,
+                                 widths=None,
+                                 activation: str | None = None,
+                                 final_activation: str | None = None,
+                                 what: str = "checkpoint") -> None:
+    """Raise ``ValueError`` with a CLEAR message when the checkpoint's
+    recorded provenance contradicts the given plan / model config.  Fields
+    the checkpoint does not record are skipped (pre-provenance files load)."""
+    if plan is not None and meta.get("plan_digest") is not None:
+        from ..obs.recorder import plan_digest
+        have = plan_digest(plan)
+        if have != meta["plan_digest"]:
+            raise ValueError(
+                f"{what}: plan digest mismatch — checkpoint was saved under "
+                f"plan {meta['plan_digest']}, this run's plan is {have}: a "
+                "different graph, partvec, k or comm layout.  Model weights "
+                "are partition-independent, so a same-graph re-partition can "
+                "be restored deliberately (load_checkpoint(..., "
+                "verify=False)); a different GRAPH cannot — check "
+                "read_checkpoint_meta before overriding.")
+    cfg = meta.get("model_config") or {}
+    # activation is part of the served function, not just bookkeeping: the
+    # same param tree under a different activation restores cleanly and
+    # computes different logits — exactly the silent-wrong-model class this
+    # layer exists to catch
+    for key, want in (("model", model), ("fin", fin),
+                      ("widths", list(widths) if widths is not None
+                       else None),
+                      ("activation", activation),
+                      ("final_activation", final_activation)):
+        if want is not None and cfg.get(key) is not None and cfg[key] != want:
+            raise ValueError(
+                f"{what}: model config mismatch on {key!r} — checkpoint "
+                f"records {cfg[key]!r}, this run asks for {want!r}; "
+                "reconstruct the trainer/engine with the checkpoint's "
+                "config (read_checkpoint_meta shows it).")
+
+
+def load_checkpoint_leaves(path: str) -> tuple[list, dict]:
+    """``(leaves, meta)`` — every ``leaf_<i>`` array in index order plus the
+    provenance block.  The serve engine restores params-only trees from
+    this (the leading leaves of the ``(params, opt_state)`` flattening)."""
+    meta = read_checkpoint_meta(path)
+    with np.load(_norm(path)) as data:
+        leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+    return leaves, meta
+
+
+def load_checkpoint(trainer, path: str, verify: bool = True) -> int:
     """Restore params/opt_state in place; returns the saved step counter.
 
     The trainer must have been constructed with the same model config — the
-    leaf count and shapes are validated against its current trees.
+    recorded provenance (plan digest, model kind, dims) is verified FIRST
+    with a clear message, then the leaf count and shapes are validated
+    against its current trees.  ``verify=False`` skips the provenance check
+    (weights are partition-independent, so a deliberate same-graph
+    re-partition restore is legitimate); the shape validation always runs.
     """
-    with np.load(_norm(path)) as data:
-        step = int(data["__step__"])
-        leaves = [data[f"leaf_{i}"]
-                  for i in range(len(data.files) - 1)]
+    leaves, meta = load_checkpoint_leaves(path)
+    if verify:
+        verify_checkpoint_provenance(
+            meta, plan=getattr(trainer, "plan", None),
+            model=getattr(trainer, "model", None),
+            fin=getattr(trainer, "fin", None),
+            widths=getattr(trainer, "widths", None),
+            activation=getattr(trainer, "activation", None),
+            final_activation=getattr(trainer, "final_activation", None),
+            what=f"load_checkpoint({path!r})")
     cur = jax.tree.leaves((trainer.params, trainer.opt_state))
     if len(cur) != len(leaves):
         raise ValueError(
@@ -59,4 +191,4 @@ def load_checkpoint(trainer, path: str) -> int:
     params, opt_state = jax.tree.unflatten(treedef, leaves)
     trainer.params = replicate(trainer.mesh, params)
     trainer.opt_state = replicate(trainer.mesh, opt_state)
-    return step
+    return meta["step"]

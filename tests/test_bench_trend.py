@@ -256,3 +256,48 @@ def test_bench_emits_the_measured_flag():
     with open(os.path.join(REPO, "bench.py")) as fh:
         src = fh.read()
     assert src.count('"measured": True') >= 2
+
+
+def test_serve_series_registration(tmp_path):
+    """The PR-8 serving series: measured latency/QPS register REPORT-ONLY
+    (non-"s" units — never banded), the plan-derived wire-row gauges as
+    zero-band counters scoped to the serve config; a wire-row increase
+    within one config trips the gate, a latency increase does not."""
+    from bench_trend import _SERVE_CFG_KEYS
+
+    def serve_rec(p50, wire_q, nnz=160000):
+        arms = {"a2a": {"achieved_qps": 40.0, "latency_p50_ms": p50,
+                        "latency_p99_ms": p50 * 3,
+                        "wire_rows_per_exchange": 1000,
+                        "wire_rows_per_query": 187.5},
+                "ragged": {"achieved_qps": 42.0, "latency_p50_ms": p50,
+                           "latency_p99_ms": p50 * 3,
+                           "wire_rows_per_exchange": 600,
+                           "wire_rows_per_query": wire_q}}
+        return _rec(0.1, serve_qps_8dev={
+            "n": 20000, "graph": "ba", "nnz": nnz, "nlayers": 2, "k": 8,
+            "offered_qps": 50.0, "max_batch": 16, "measured": True,
+            "arms": arms})
+
+    root = _write_history(tmp_path, [
+        (1, serve_rec(4.0, 112.5)), (2, serve_rec(9.0, 112.5)),
+    ])
+    block = serve_rec(0, 0)["parsed"]["serve_qps_8dev"]
+    cfg = tuple(block[k] for k in _SERVE_CFG_KEYS)
+    series, _ = extract_series(load_history(root))
+    lat_key = ("metric", "serve_ragged_latency_p50_ms", "serve", "ms") + cfg
+    assert [v for _, v in series[lat_key]] == [4.0, 9.0]
+    ctr_key = ("counter", "serve_ragged_wire_rows_per_query") + cfg
+    assert [v for _, v in series[ctr_key]] == [112.5, 112.5]
+    assert not check_series(series)     # latency doubled: report-only
+    # a denser graph (different nnz) is a NEW series, not a regression
+    with open(os.path.join(root, "BENCH_r03.json"), "w") as fh:
+        json.dump(serve_rec(4.0, 300.0, nnz=640000), fh)
+    series, _ = extract_series(load_history(root))
+    assert not check_series(series)
+    # but a wire-row regression within ONE config DOES trip the zero band
+    with open(os.path.join(root, "BENCH_r04.json"), "w") as fh:
+        json.dump(serve_rec(4.0, 150.0), fh)
+    series, _ = extract_series(load_history(root))
+    problems = check_series(series)
+    assert any("serve_ragged_wire_rows_per_query" in p for p in problems)

@@ -45,6 +45,9 @@ SUBPROCESS_BUDGET_ALLOWLIST = {
                               "stdlib JSON checks, sub-second, no jax",
     "test_bench_trend.py": "three bench_trend.py CLI children — pure "
                            "stdlib JSON trend checks, sub-second, no jax",
+    "test_serve.py": "one serve-CLI child + one obs_report render on the "
+                     "small cora fixture (closed-loop micro-batch smoke, "
+                     "24 queries, one compiled bucket; ~1 min)",
 }
 
 _SPAWN_RE = re.compile(

@@ -24,6 +24,7 @@ from sgcn_tpu.parallel.plan import (_GLOBAL_ARRAY_FIELDS,
                                     STALE_PLAN_FIELDS_RAGGED, CommPlan)
 from sgcn_tpu.partition import balanced_random_partition
 from sgcn_tpu.prep import normalize_adjacency
+from sgcn_tpu.serve.router import SERVE_ROUTER_FIELDS
 
 # every tuple that names CommPlan fields for shipping/slicing, in one place
 CONSUMER_TUPLES = {
@@ -36,6 +37,7 @@ CONSUMER_TUPLES = {
     "GCN_PLAN_FIELDS_GEN": GCN_PLAN_FIELDS_GEN,
     "GCN_PLAN_FIELDS_RAGGED": GCN_PLAN_FIELDS_RAGGED,
     "STALE_PLAN_FIELDS_RAGGED": STALE_PLAN_FIELDS_RAGGED,
+    "SERVE_ROUTER_FIELDS": SERVE_ROUTER_FIELDS,
 }
 
 
@@ -128,3 +130,29 @@ def test_ragged_fields_covered_on_day_one():
     assert set(STALE_PLAN_FIELDS_RAGGED) <= set(PER_CHIP_ARRAY_FIELDS)
     assert {"rsend_idx", "redge_dst"} <= set(STALE_PLAN_FIELDS_RAGGED)
     assert not {"send_idx", "halo_src"} & set(STALE_PLAN_FIELDS_RAGGED)
+
+
+def test_serve_fields_covered_on_day_one():
+    """The PR-8 serve subsystem under the same static gates: the router's
+    fields are GLOBAL vertex-indexed (never per-chip — routing runs on the
+    host over the full square plan), and the engine ships ONLY the model
+    tuples already under contract (`resolve_forward_setup` returns them),
+    so a new forward field cannot bypass this lint via the serving path."""
+    from sgcn_tpu.train.fullbatch import resolve_forward_setup
+
+    for f in SERVE_ROUTER_FIELDS:
+        assert f in _GLOBAL_ARRAY_FIELDS, (
+            f"SERVE_ROUTER_FIELDS names {f}, which is not classified "
+            "global — the router would mis-read a per-chip-stacked array")
+        assert f not in PER_CHIP_ARRAY_FIELDS, f
+    covered = {tuple(sorted(t)) for n, t in CONSUMER_TUPLES.items()
+               if n not in ("PER_CHIP_ARRAY_FIELDS", "_GLOBAL_ARRAY_FIELDS",
+                            "SERVE_ROUTER_FIELDS")}
+    plan = _full_plan()
+    for model in ("gcn", "gat"):
+        for sched in ("a2a", "ragged"):
+            setup = resolve_forward_setup(plan, fin=16, widths=[16, 4],
+                                          model=model, comm_schedule=sched)
+            assert tuple(sorted(setup.plan_fields)) in covered, (
+                f"serve/{model}/{sched} ships {setup.plan_fields}, which "
+                "is not one of the contract tuples above")

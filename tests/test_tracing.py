@@ -435,9 +435,14 @@ def test_v1_stream_may_not_carry_v2_kinds():
     with pytest.raises(ValueError, match="kind"):
         validate_event({"v": 1, "ts": 1.0, "kind": "span",
                         "name": "x", "dur_s": 0.1})
+    # a v2 stream may not carry the v3-only serve kind either
+    with pytest.raises(ValueError, match="kind"):
+        validate_event({"v": 2, "ts": 1.0, "kind": "serve", "queries": 1,
+                        "achieved_qps": 1.0, "latency_p50_ms": 1.0,
+                        "latency_p95_ms": 1.0, "latency_p99_ms": 1.0})
     # unknown version is rejected outright
     with pytest.raises(ValueError, match="version"):
-        validate_event({"v": 3, "ts": 1.0, "kind": "step", "step": 1,
+        validate_event({"v": 99, "ts": 1.0, "kind": "step", "step": 1,
                         "loss": 1.0, "wall_s": 0.1})
 
 

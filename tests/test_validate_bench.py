@@ -16,7 +16,8 @@ sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from validate_bench import (check_bench_record, check_multichip_record,  # noqa: E402
                             check_products_ksweep, check_ragged_ab,
-                            check_ragged_stale_ab, validate_tree)
+                            check_ragged_stale_ab, check_serve_qps,
+                            validate_tree)
 
 
 def test_checked_in_artifacts_validate():
@@ -136,6 +137,63 @@ def test_validator_ragged_stale_ab_contract():
         {"ragged_stale_ab_8dev": no_note}))
     assert any("missing arm" in e for e in check_ragged_stale_ab(
         {"ragged_stale_ab_8dev": {"arms": {"a2a_stale": _rsab_arm(1, 10)}}}))
+
+
+def _serve_arm(wire, **over):
+    a = {"achieved_qps": 48.0, "latency_p50_ms": 4.0, "latency_p99_ms": 11.0,
+         "queries": 200, "compiles": 2, "buckets": [8, 16],
+         "wire_rows_per_exchange": wire,
+         "wire_rows_per_query": round(wire * 3 / 16, 3),
+         "true_rows_per_exchange": min(400, wire)}
+    a.update(over)
+    return a
+
+
+def _serve_block(**over):
+    b = {"measured": True, "offered_qps": 50.0,
+         "arms": {"a2a": _serve_arm(1000), "ragged": _serve_arm(600)},
+         "note": "CPU-mesh latency is not the claim; the wire-row "
+                 "accounting is the asserted figure"}
+    b.update(over)
+    return b
+
+
+def test_validator_serve_qps_contract():
+    """The serving-bench block (PR-8): null needs a degradation marker;
+    latency claims need measured:true provenance; a runtime recompile
+    (compiles > buckets) violates the bucket contract; the ragged arm must
+    win the wire-row accounting STRICTLY; the honest-measurement note is
+    required."""
+    assert any("serve_qps_degraded" in e for e in check_serve_qps(
+        {"serve_qps_8dev": None}))
+    assert not check_serve_qps({"serve_qps_8dev": None,
+                                "serve_qps_degraded": "deadline"})
+    assert not check_serve_qps({"serve_qps_8dev": _serve_block()})
+    errs = check_serve_qps({"serve_qps_8dev": _serve_block(measured=False)})
+    assert any("measured:true" in e for e in errs)
+    bad_q = _serve_block()
+    bad_q["arms"]["ragged"] = _serve_arm(600, latency_p50_ms=20.0)
+    assert any("quantiles" in e for e in check_serve_qps(
+        {"serve_qps_8dev": bad_q}))
+    bad_c = _serve_block()
+    bad_c["arms"]["a2a"] = _serve_arm(1000, compiles=5)
+    assert any("recompile" in e for e in check_serve_qps(
+        {"serve_qps_8dev": bad_c}))
+    bad_w = _serve_block()
+    bad_w["arms"]["ragged"] = _serve_arm(1000)
+    assert any("STRICTLY" in e for e in check_serve_qps(
+        {"serve_qps_8dev": bad_w}))
+    no_note = _serve_block(note="fast")
+    assert any("note" in e for e in check_serve_qps(
+        {"serve_qps_8dev": no_note}))
+    assert any("missing arm" in e for e in check_serve_qps(
+        {"serve_qps_8dev": _serve_block(arms={"a2a": _serve_arm(10)})}))
+    # the block rides check_bench_record like the other A/B families
+    rec = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+           "parsed": {"metric": "serve_qps_ab", "value": None,
+                      "degraded": "no mesh",
+                      "serve_qps_8dev": None}}
+    assert any("serve_qps_degraded" in e for e in check_bench_record(rec))
 
 
 def test_validator_rejects_unresolved_comm_schedule():
