@@ -57,6 +57,12 @@ What counts as a violation:
     partition (the forward-only carry-over of the training schedules'
     acceptance figure — never CPU-mesh latency; the ``note`` says so), or
     be ``null`` with a ``serve_qps_degraded`` marker;
+  * **static-analysis report** (``bench_artifacts/analysis_report.json``,
+    PR-9): a committed report must be a FULL-matrix run (``fast: false``)
+    with ``ok: true`` and internally consistent — an ``ok`` flag
+    contradicting its own violation lists, a red report committed as
+    evidence, or a matrix shrunk below the supported floor are all
+    hand-edit tells (``check_analysis_report``);
   * **the pow2-k RB constraint** (``products_ksweep.json``): ``hp_rb``
     entries at non-power-of-two k, or k < 32.  The PR-2 review incident:
     ``partition_hypergraph_rb`` recurses on k/2 and the auto-select
@@ -383,6 +389,84 @@ def check_ragged_ab(parsed: dict, prefix: str = "ragged_ab") -> list[str]:
     return errs
 
 
+# the supported-matrix floor a committed analysis report may not shrink
+# below (27 mode entries at PR-9 HEAD; the matrix only grows)
+ANALYSIS_MIN_MODES = 27
+
+
+def check_analysis_report(rec: dict) -> list[str]:
+    """The committed-analysis-report contract (module docstring): schema'd,
+    full-matrix, green, and self-consistent — every ``ok`` flag must agree
+    with the violation lists under it."""
+    errs = []
+    if rec.get("schema") != "sgcn_analysis_report":
+        return [f"schema={rec.get('schema')!r}, expected "
+                "'sgcn_analysis_report'"]
+    if not isinstance(rec.get("v"), numbers.Integral):
+        errs.append("missing integer schema version 'v'")
+    if rec.get("fast") is not False:
+        errs.append("committed report must be a FULL-matrix run "
+                    "(fast: false) — the --fast subset is a smoke, not "
+                    "evidence")
+    if rec.get("ok") is not True:
+        errs.append("ok is not true — fix the violations (or the rules) "
+                    "instead of committing a red report as evidence")
+    hlo = rec.get("hlo")
+    if not isinstance(hlo, dict) or not isinstance(hlo.get("modes"), dict):
+        errs.append("missing hlo.modes block")
+        return errs
+    modes = hlo["modes"]
+    if hlo.get("n_modes") != len(modes):
+        errs.append(f"hlo.n_modes={hlo.get('n_modes')!r} != "
+                    f"{len(modes)} mode entries — inconsistent")
+    if len(modes) < ANALYSIS_MIN_MODES:
+        errs.append(f"{len(modes)} mode entries below the supported-"
+                    f"matrix floor {ANALYSIS_MIN_MODES} — the matrix "
+                    "only grows; a shrunk report is a silently narrowed "
+                    "audit")
+    for mid, entry in modes.items():
+        progs = entry.get("programs")
+        if not isinstance(progs, dict) or not progs:
+            errs.append(f"hlo.modes[{mid}]: no programs block")
+            continue
+        viols = [v for p in progs.values()
+                 for v in p.get("violations", [])]
+        if bool(entry.get("ok")) == bool(viols):
+            errs.append(f"hlo.modes[{mid}]: ok={entry.get('ok')!r} "
+                        f"contradicts {len(viols)} recorded violation(s)")
+        for label, p in progs.items():
+            if bool(p.get("ok")) == bool(p.get("violations")):
+                errs.append(f"hlo.modes[{mid}].programs[{label}]: "
+                            f"ok={p.get('ok')!r} contradicts its "
+                            "violation list")
+            if p.get("ok") is not True:
+                errs.append(f"hlo.modes[{mid}].programs[{label}]: "
+                            f"ok={p.get('ok')!r} — a committed report "
+                            "must be green in every program")
+        if entry.get("ok") is not True:
+            # green-only must hold per ENTRY, not just at the top — else
+            # the one-line hand-edit (flip the top-level booleans) passes
+            errs.append(f"hlo.modes[{mid}]: ok={entry.get('ok')!r} — a "
+                        "committed report must be green in every mode")
+    if hlo.get("ok") is not True:
+        errs.append("hlo.ok is not true")
+    ast_block = rec.get("ast")
+    if not isinstance(ast_block, dict) or not isinstance(
+            ast_block.get("rules"), dict):
+        errs.append("missing ast.rules block")
+        return errs
+    for name, entry in ast_block["rules"].items():
+        if bool(entry.get("ok")) == bool(entry.get("violations")):
+            errs.append(f"ast.rules[{name}]: ok={entry.get('ok')!r} "
+                        "contradicts its violation list")
+        if entry.get("ok") is not True:
+            errs.append(f"ast.rules[{name}]: ok={entry.get('ok')!r} — a "
+                        "committed report must be green in every rule")
+    if ast_block.get("ok") is not True:
+        errs.append("ast.ok is not true")
+    return errs
+
+
 def check_multichip_record(rec: dict) -> list[str]:
     errs = []
     if not isinstance(rec.get("n_devices"), numbers.Integral):
@@ -471,6 +555,7 @@ def check_shard_epoch_model(rec: dict) -> list[str]:
 
 # artifact filename -> dedicated checker (everything else: strict-parse only)
 _ARTIFACT_CHECKS = {
+    "analysis_report.json": check_analysis_report,
     "products_ksweep.json": check_products_ksweep,
     "products_partition.json": check_products_partition,
     "products_partition_dcsbm.json": check_products_partition,

@@ -20,6 +20,10 @@ _TOOLS = (
     ("sgcn_tpu.shp", "stochastic hypergraph model (GPU/SHP role)"),
     ("sgcn_tpu.baselines", "oracle (DGL role) and cagnet (CAGNET role) "
                            "comparison baselines"),
+    ("sgcn_tpu.serve", "AOT-compiled partitioned inference under "
+                       "synthetic query traffic (docs/serving.md)"),
+    ("sgcn_tpu.analysis", "static analysis: compiled-program contract "
+                          "audit + AST hygiene (docs/static_analysis.md)"),
 )
 
 

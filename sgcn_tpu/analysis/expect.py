@@ -1,0 +1,227 @@
+"""Plan-derived expectations for the compiled-program audit.
+
+Everything the HLO auditor asserts is computed HERE, from the same plan
+fields and shared helpers the real programs are built from — never from a
+golden dump of a previous lowering:
+
+  * exchange collectives ride ``CommPlan.wire_buffer_shapes`` (the
+    ``(peers, S)`` dense pad / per-live-round ``(S_d,)`` ring buffers,
+    empty rounds elided per ``ops.pspmm.ragged_live_rounds``) crossed with
+    the model's lane widths (``models.gcn.exchange_widths`` /
+    ``models.gat.gat_table_form``);
+  * the gradient allreduce census is the trainer's own parameter pytree —
+    one full-mesh ``psum`` per leaf;
+  * donation expectations are the trainer's argument pytrees classified
+    donate/keep exactly as ``donate_argnums`` classifies them.
+
+One constant is pinned empirically rather than derived:
+``XENT_SCALAR_PSUMS`` — the scalar f32 allreduces the masked-xent loss
+machinery lowers to (two ``lax.psum`` calls in
+``models.gcn.masked_softmax_xent_local`` plus one re-emitted on the
+linearized path by JAX's partial evaluation).  It is a property of the
+loss code + JAX version, not of the plan; the full-matrix audit at HEAD
+validates it for every mode, and a loss-code change that shifts it fails
+the audit loudly (the point of a lint).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+# scalar f32 add-allreduces of one masked-xent train step (see module
+# docstring); every audited train program uses the xent loss
+XENT_SCALAR_PSUMS = 3
+
+_DTYPE_SHORT = {
+    "float32": "f32", "bfloat16": "bf16", "float64": "f64", "float16":
+    "f16", "int8": "i8", "int16": "i16", "int32": "i32", "int64": "i64",
+    "uint8": "ui8", "uint32": "ui32", "bool": "i1",
+}
+
+
+def dtype_short(dt) -> str:
+    return _DTYPE_SHORT.get(np.dtype(dt).name if not isinstance(dt, str)
+                            else dt, str(dt))
+
+
+@dataclass
+class Expectation:
+    """What one lowered program must contain."""
+
+    # exchange collectives: multiset of (kind, wire shape, wire dtype)
+    exchanges: list = field(default_factory=list)
+    # grad-sync allreduces: multiset of operand shapes (add-reduce, f32)
+    grad_shapes: list = field(default_factory=list)
+    # scalar f32 add-allreduces (loss machinery)
+    scalar_psums: int = 0
+    # max-allreduces (the GAT per-layer softmax stabilizer pmax): count
+    max_psums: int = 0
+    # serve logit gather: list of (shape,) add-allreduce operands
+    gather_shapes: list = field(default_factory=list)
+    # argument classification for the donation check, in flatten order:
+    # list of (shape, dtype, klass) with klass in {'donate', 'keep'}
+    args: list = field(default_factory=list)
+
+
+def _gcn_layer_plan(fin: int, widths) -> tuple[list, list]:
+    """(per-layer exchanged lane widths, per-layer project-first flags) —
+    the lane widths are ``models.gcn.exchange_widths`` verbatim; the flags
+    re-state its condition so the backward-exchange census below can apply
+    the layer-0 dead-code rule."""
+    from ..models.gcn import PROJECT_FIRST_MIN_FIN, exchange_widths
+
+    fs = exchange_widths(fin, list(widths))
+    pf, f = [], fin
+    for w in widths:
+        pf.append(bool(w < f and f >= PROJECT_FIRST_MIN_FIN))
+        f = w
+    return fs, pf
+
+
+def _exchange_ops(plan, schedule: str, lane: int | None, dtype: str) -> list:
+    """The collective dispatches of ONE halo exchange shipping ``lane``
+    trailing lanes (``None`` = no lane axis, e.g. the GAT split scalar)."""
+    kind = "all_to_all" if schedule == "a2a" else "collective_permute"
+    out = []
+    for shape in plan.wire_buffer_shapes(schedule):
+        full = shape if lane is None else shape + (lane,)
+        out.append((kind, full, dtype))
+    return out
+
+
+def _wire_dtypes_gcn(mode, fresh: bool) -> tuple[str, str]:
+    """(feature wire, gradient wire) dtypes of one GCN step — the
+    ``halo_dtype`` / ``--halo-delta`` / f32-rebase rules of
+    ``ops.pspmm._stale_exchange`` and ``halo_exchange``."""
+    base = "bf16" if mode.halo_dtype == "bfloat16" else "f32"
+    if not mode.staleness:
+        return base, base
+    if mode.delta:
+        # stale steps ship the bf16 increment; a fresh step RE-BASES on the
+        # full f32 row (both ends reset exactly — docs/stale_halo.md)
+        return ("f32" if fresh else "bf16"), base
+    return base, base
+
+
+def train_expectation(trainer, mode, fresh: bool = False) -> Expectation:
+    """Expected contents of one lowered train step for ``mode``.
+
+    ``fresh`` selects the stale mode's full-sync program (both programs of
+    a stale mode are audited — the f32 delta re-base is a sync-step-only
+    contract)."""
+    import jax
+
+    plan = trainer.plan
+    exp = Expectation()
+    L = trainer.nlayers
+
+    if mode.model == "gcn":
+        fs, pf = _gcn_layer_plan(trainer.fin, trainer.widths)
+        fdt, gdt = _wire_dtypes_gcn(mode, fresh)
+        for i in range(L):                       # forward: every layer
+            exp.exchanges += _exchange_ops(plan, mode.schedule, fs[i], fdt)
+        if mode.staleness:
+            # backward: the fresh gradient ring/a2a is EMITTED for every
+            # layer — it is next step's carry, so layer 0's survives even
+            # though dL/dh0 is dead
+            bwd_layers = range(L)
+        else:
+            # exact mode: layer 0's backward exchange exists only under
+            # project-first (dL/d(h·W) feeds dW); aggregate-first layer 0
+            # only needs dL/dagg-out, and its dL/dh0 path is dead code
+            bwd_layers = [i for i in range(L) if i > 0 or pf[0]]
+        for i in bwd_layers:
+            exp.exchanges += _exchange_ops(plan, mode.schedule, fs[i], gdt)
+    else:
+        from ..models.gat import gat_table_form
+        for i in range(L):
+            fout = trainer.widths[i]
+            form = gat_table_form(fout, mode.compute_dtype)
+            for _direction in ("fwd", "bwd"):    # both ride the same form
+                if form == "packed":
+                    exp.exchanges += _exchange_ops(
+                        plan, mode.schedule, fout // 2 + 1, "f32")
+                elif form == "fused":
+                    exp.exchanges += _exchange_ops(
+                        plan, mode.schedule, fout + 1, "f32")
+                elif mode.schedule == "a2a":
+                    # split pair: feature table + its own scalar buffer —
+                    # TWO dense dispatches per exchange
+                    exp.exchanges += _exchange_ops(plan, "a2a", fout, "f32")
+                    exp.exchanges += _exchange_ops(plan, "a2a", None, "f32")
+                else:
+                    # on the ring the pair collapses into ONE two-lane
+                    # dispatch per live round (halo_exchange_ragged_multi)
+                    exp.exchanges += _exchange_ops(
+                        plan, "ragged", fout + 1, "f32")
+        exp.max_psums = L                        # per-layer softmax pmax
+
+    exp.grad_shapes = [tuple(np.shape(x))
+                       for x in jax.tree.leaves(trainer.params)]
+    exp.scalar_psums = XENT_SCALAR_PSUMS
+
+    # argument classification (donation): the jit args in flatten order
+    groups = [("donate", trainer.params), ("donate", trainer.opt_state)]
+    if mode.staleness:
+        groups.append(("donate", trainer.halo_carry))
+    groups += [("keep", trainer.pa)]
+    exp.args = _classify_args(groups)
+    k, b = plan.k, plan.b
+    exp.args += [((k, b, trainer.fin), "f32", "keep"),   # h0
+                 ((k, b), "i32", "keep"),                # labels
+                 ((k, b), "f32", "keep")]                # valid
+    return exp
+
+
+def serve_expectation(engine, mode, bucket: int) -> Expectation:
+    """Expected contents of one lowered serve bucket program: L forward
+    exchanges, ONE full-mesh logit-gather psum, and NO donated inputs
+    (engine params/plan arrays are reused across micro-batches)."""
+    import jax
+
+    plan = engine.plan
+    exp = Expectation()
+    L = engine.nlayers
+    if mode.model == "gcn":
+        fs, _ = _gcn_layer_plan(engine.fin, engine.widths)
+        dt = "bf16" if mode.halo_dtype == "bfloat16" else "f32"
+        for i in range(L):
+            exp.exchanges += _exchange_ops(plan, mode.schedule, fs[i], dt)
+    else:
+        from ..models.gat import gat_table_form
+        for i in range(L):
+            fout = engine.widths[i]
+            form = gat_table_form(fout, None)
+            if form == "fused":
+                exp.exchanges += _exchange_ops(
+                    plan, mode.schedule, fout + 1, "f32")
+            elif mode.schedule == "a2a":
+                exp.exchanges += _exchange_ops(plan, "a2a", fout, "f32")
+                exp.exchanges += _exchange_ops(plan, "a2a", None, "f32")
+            else:
+                exp.exchanges += _exchange_ops(
+                    plan, "ragged", fout + 1, "f32")
+        exp.max_psums = L
+    exp.gather_shapes = [(bucket, engine.widths[-1])]
+    groups = [("keep", engine.params), ("keep", engine.pa)]
+    exp.args = _classify_args(groups)
+    k, b = plan.k, plan.b
+    exp.args += [((k, b, engine.fin), "f32", "keep"),    # h0
+                 ((bucket,), "i32", "keep"),             # q_owner
+                 ((bucket,), "i32", "keep")]             # q_local
+    return exp
+
+
+def _classify_args(groups) -> list:
+    import jax
+
+    out = []
+    for klass, tree in groups:
+        for leaf in jax.tree.leaves(tree):
+            out.append((tuple(np.shape(leaf)),
+                        dtype_short(np.asarray(leaf).dtype
+                                    if not hasattr(leaf, "dtype")
+                                    else leaf.dtype), klass))
+    return out

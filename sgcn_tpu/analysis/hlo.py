@@ -1,0 +1,213 @@
+"""Shared HLO/StableHLO text parsing — ONE implementation for every
+compiled-program check in the repo.
+
+Two dialects, two consumers:
+
+  * **StableHLO MLIR** (``jax.jit(...).lower(...).as_text()``): what the
+    mode-matrix auditor (``hlo_audit``) reads — collective ops with operand
+    dtypes/shapes, main-function argument donation attributes
+    (``jax.buffer_donor``), custom-call targets.  Ops may span many lines
+    (``all_reduce`` carries a reduction region), so extraction scans from
+    the op head to its ``: (operand types) -> result types`` signature.
+  * **scheduled HLO** (``lowered.compile().as_text()`` on a real backend):
+    what the overlap evidence test reads — async collective
+    ``-start``/``-done`` pairs and the compute scheduled inside each
+    window (``tests/test_overlap_hlo.py``).
+
+Nothing here imports jax: parsing is pure text, so the AST/CLI paths can
+load it without touching a backend.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+# StableHLO ops the audit inventories.  ``send``/``recv``/``infeed``/
+# ``outfeed`` are collected so their PRESENCE can be flagged (a step
+# program must never carry host-transfer ops).
+COLLECTIVE_KINDS = ("all_to_all", "all_reduce", "collective_permute",
+                    "all_gather", "reduce_scatter")
+HOST_TRANSFER_KINDS = ("infeed", "outfeed", "send", "recv")
+
+_OP_HEAD_RE = re.compile(
+    r'"?stablehlo\.(' + "|".join(COLLECTIVE_KINDS + HOST_TRANSFER_KINDS)
+    + r')"?\b')
+
+# the plumbing custom-call targets SPMD partitioning itself emits — always
+# legitimate inside a step program
+BENIGN_CUSTOM_CALLS = frozenset({
+    "Sharding", "SPMDFullToShardShape", "SPMDShardToFullShape",
+})
+
+# targets that smuggle a HOST round-trip into the program: the python
+# callback family (jax.debug.print / debug.callback / pure_callback /
+# io_callback lower to these) — one of them inside a step program turns a
+# device-rate hot loop into a host-rate one
+HOST_CALLBACK_RE = re.compile(
+    r"(callback|CallbackTo|host_callback)", re.IGNORECASE)
+
+_TYPE_SIG_RE = re.compile(r':\s*\(([^()]*)\)\s*->\s*(.+?)\s*$')
+_TENSOR_RE = re.compile(r'tensor<([^>]*)>')
+_CUSTOM_TARGET_RE = re.compile(r'stablehlo\.custom_call\s+@(\w+)')
+_REDUCER_RE = re.compile(
+    r'stablehlo\.(add|maximum|minimum|multiply|and|or|xor)\b')
+
+
+def parse_tensor_type(t: str) -> tuple[tuple[int, ...], str]:
+    """``'8x10x8xbf16'`` → ``((8, 10, 8), 'bf16')``; ``'f32'`` → ``((), 'f32')``."""
+    parts = t.strip().split("x")
+    dims, i = [], 0
+    while i < len(parts) and parts[i].isdigit():
+        dims.append(int(parts[i]))
+        i += 1
+    return tuple(dims), "x".join(parts[i:])
+
+
+@dataclass
+class HloOp:
+    """One inventoried StableHLO op."""
+
+    kind: str                      # 'all_to_all', 'all_reduce', ...
+    line: int                      # 0-based line of the op head
+    operand_types: list = field(default_factory=list)   # [(shape, dtype)]
+    result_types: list = field(default_factory=list)
+    reducer: str | None = None     # all_reduce region body ('add', 'maximum')
+    text: str = ""                 # joined op text (head → type signature)
+
+    @property
+    def wire(self) -> tuple:
+        """(shape, dtype) of the first operand — the wire buffer of a
+        collective dispatch."""
+        return self.operand_types[0] if self.operand_types else ((), "?")
+
+
+def collective_ops(text: str, max_span: int = 400) -> list[HloOp]:
+    """Inventory every collective / host-transfer StableHLO op in a lowered
+    module.  Ops with regions (``all_reduce``) span lines; the op's operand
+    and result types are read from the ``: (…) -> …`` signature that closes
+    it, and the reduction body (``stablehlo.add`` / ``maximum`` …) is
+    captured for reduce classification."""
+    lines = text.splitlines()
+    ops: list[HloOp] = []
+    for i, ln in enumerate(lines):
+        m = _OP_HEAD_RE.search(ln)
+        if not m:
+            continue
+        kind = m.group(1)
+        joined = []
+        sig = None
+        for j in range(i, min(i + max_span, len(lines))):
+            joined.append(lines[j])
+            sig = _TYPE_SIG_RE.search(lines[j])
+            if sig:
+                break
+        op = HloOp(kind=kind, line=i, text="\n".join(joined))
+        if sig:
+            op.operand_types = [parse_tensor_type(t)
+                                for t in _TENSOR_RE.findall(sig.group(1))]
+            op.result_types = [parse_tensor_type(t)
+                               for t in _TENSOR_RE.findall(sig.group(2))]
+        if kind == "all_reduce":
+            r = _REDUCER_RE.search(op.text)
+            op.reducer = r.group(1) if r else None
+        ops.append(op)
+    return ops
+
+
+def custom_call_targets(text: str) -> list[str]:
+    """Every ``stablehlo.custom_call @Target`` in the module, in order."""
+    return _CUSTOM_TARGET_RE.findall(text)
+
+
+def host_callback_targets(text: str) -> list[str]:
+    """The custom-call targets that smuggle a host round-trip into the
+    program (python-callback family), plus any ``@Target`` outside the
+    benign SPMD-plumbing set that LOOKS like a callback."""
+    return [t for t in custom_call_targets(text)
+            if t not in BENIGN_CUSTOM_CALLS and HOST_CALLBACK_RE.search(t)]
+
+
+def unknown_custom_calls(text: str) -> list[str]:
+    """Custom-call targets that are neither SPMD plumbing nor recognized
+    callbacks — surfaced so a NEW target class is a loud audit finding
+    (e.g. a Pallas ``tpu_custom_call`` showing up in a mode that pins the
+    ELL aggregator), never a silent pass."""
+    return [t for t in custom_call_targets(text)
+            if t not in BENIGN_CUSTOM_CALLS
+            and not HOST_CALLBACK_RE.search(t)]
+
+
+# --------------------------------------------------------------- main() args
+@dataclass
+class FuncArg:
+    index: int
+    type: tuple                    # (shape, dtype)
+    donated: bool
+    attrs: str
+
+
+_MAIN_RE = re.compile(r'func\.func\s+public\s+@main\((.*?)\)\s*->', re.S)
+_ARG_SPLIT_RE = re.compile(r'%arg(\d+):\s*tensor<([^>]*)>')
+
+
+def main_args(text: str) -> list[FuncArg]:
+    """The main function's arguments with their ``jax.buffer_donor``
+    donation markers — the lowering-time form of ``donate_argnums``.  Each
+    argument's attribute span runs to the next ``%arg`` head (attribute
+    dicts may nest braces inside quoted sharding strings, so spans — not
+    brace matching — delimit them)."""
+    m = _MAIN_RE.search(text)
+    if not m:
+        raise ValueError("no public @main function in module text")
+    body = m.group(1)
+    heads = list(_ARG_SPLIT_RE.finditer(body))
+    out = []
+    for i, h in enumerate(heads):
+        end = heads[i + 1].start() if i + 1 < len(heads) else len(body)
+        attrs = body[h.end(): end]
+        out.append(FuncArg(index=int(h.group(1)),
+                           type=parse_tensor_type(h.group(2)),
+                           donated="jax.buffer_donor" in attrs,
+                           attrs=attrs.strip()))
+    return out
+
+
+# ----------------------------------------------------- scheduled-HLO (async)
+def count_async_starts(text: str, kind: str = "all-to-all") -> int:
+    """Number of ``%<kind>-start`` values in a scheduled HLO module — zero
+    when the program was not compiled with the async-collective flags."""
+    return len(re.findall(rf"^\s*%{kind}-start[\w.\-]* = ", text,
+                          flags=re.M))
+
+
+def async_windows(text: str, kind: str = "all-to-all",
+                  body_pattern: str = r"fusion\(") -> list[int]:
+    """Pair each async ``%<kind>-start`` with ITS ``-done`` via the SSA
+    value name in a scheduled HLO module and count ``body_pattern`` matches
+    strictly inside each start→done window — the compiled-schedule form of
+    "real compute runs while the collective is in flight".
+
+    Raises ``ValueError`` on a ``-done`` consuming an unknown start or any
+    start left unmatched (a malformed schedule must fail the caller, not
+    read as zero overlap)."""
+    lines = text.splitlines()
+    starts: dict[str, int] = {}
+    for i, ln in enumerate(lines):
+        m = re.match(rf"\s*(%{kind}-start[\w.\-]*) = ", ln)
+        if m:
+            starts[m.group(1)] = i
+    windows: list[int] = []
+    body_re = re.compile(body_pattern)
+    for i, ln in enumerate(lines):
+        m = re.search(rf"{kind}-done[\w.\-]*\(([^)]*)\)", ln)
+        if not m:
+            continue
+        src = m.group(1).split(",")[0].strip()
+        if src not in starts:
+            raise ValueError(f"{kind}-done consumes unknown start {src!r}")
+        s = starts.pop(src)
+        windows.append(sum(bool(body_re.search(x)) for x in lines[s + 1: i]))
+    if starts:
+        raise ValueError(f"unmatched {kind}-start(s): {sorted(starts)}")
+    return windows

@@ -1,0 +1,173 @@
+"""The mode-matrix enumerator — ONE source of truth for "which
+configurations does this repo support", shared by the HLO auditor, the
+tests, and the composition matrix in ``docs/comm_schedule.md``.
+
+A :class:`Mode` names one point of the support matrix:
+
+    {train, serve} × {gcn, gat} × {a2a, ragged} × staleness {0, 1}
+    × halo-dtype {f32, bf16} × delta {off, on} × GAT table form
+
+``supported_modes()`` enumerates exactly the combinations the trainers and
+the serve engine accept — the same gates ``FullBatchTrainer.__init__`` and
+``ServeEngine.__init__`` enforce at construction time, encoded ONCE more
+here so the auditor cannot silently skip a supported mode and the doc
+matrix cannot drift (``tests/test_analysis.py`` cross-checks the table).
+
+``MODE_FLAGS`` maps every mode-selecting CLI flag to its matrix axis; the
+AST hygiene pass (``ast_rules``) asserts every ``--comm-*`` / ``--halo-*``
+flag any CLI defines appears here, so a new transport/wire knob cannot
+land without extending the enumerator (and therefore the audit).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+# mode-selecting CLI flags → matrix axis.  The AST pass enforces the
+# reverse direction too: every MODE_FLAGS key must exist on the trainer
+# CLI (no dead axes).
+MODE_FLAGS = {
+    "--model": "model",
+    "--comm-schedule": "schedule",
+    "--halo-staleness": "staleness",
+    "--halo-dtype": "halo_dtype",
+    "--halo-delta": "delta",
+}
+
+# knobs that look mode-like but are deliberately NOT matrix axes — named
+# here so the exclusion is a recorded decision, not an oversight
+NON_AXIS_FLAGS = {
+    "--sync-every": "continuous schedule knob — audited via the stale/sync "
+                    "program PAIR every stale mode lowers, not as an axis",
+}
+
+GAT_FORMS = ("fused", "split", "packed")
+
+
+@dataclass(frozen=True)
+class Mode:
+    """One point of the supported configuration matrix."""
+
+    workload: str                  # 'train' | 'serve' | 'minibatch'
+    model: str                     # 'gcn' | 'gat'
+    schedule: str                  # 'a2a' | 'ragged'
+    staleness: int = 0             # 0 exact | 1 pipelined
+    halo_dtype: str | None = None  # None (f32 wire) | 'bfloat16'
+    delta: bool = False            # halo-delta cache (stale GCN only)
+    gat_form: str | None = None    # 'fused' | 'split' | 'packed' (GAT only)
+
+    @property
+    def mode_id(self) -> str:
+        parts = [self.workload, self.model, self.schedule]
+        if self.model == "gat":
+            parts.append(self.gat_form or "fused")
+        else:
+            parts.append(f"s{self.staleness}")
+            parts.append("bf16" if self.halo_dtype == "bfloat16" else "f32")
+            if self.delta:
+                parts.append("delta")
+        return "/".join(parts)
+
+    @property
+    def compute_dtype(self) -> str | None:
+        """The trainer-level lever that selects the GAT packed wire form
+        (``models.gat.gat_table_form``); GCN modes never set it — their
+        narrow-wire lever is ``halo_dtype``."""
+        return "bfloat16" if self.gat_form == "packed" else None
+
+
+def is_supported(mode: Mode) -> tuple[bool, str]:
+    """(supported?, reason) — the construction-time gates of the trainers
+    and the serve engine, restated.  The reason strings mirror the errors
+    the constructors raise, so a drift shows up as a wording mismatch in
+    review, not a silent matrix hole."""
+    m = mode
+    if m.workload not in ("train", "serve", "minibatch"):
+        return False, f"unknown workload {m.workload!r}"
+    if m.model not in ("gcn", "gat"):
+        return False, f"unknown model {m.model!r}"
+    if m.schedule not in ("a2a", "ragged"):
+        return False, f"unknown schedule {m.schedule!r}"
+    if m.model == "gat":
+        if m.staleness:
+            return False, ("the GAT exchange ships per-layer attention "
+                           "tables whose staleness is not supported")
+        if m.halo_dtype is not None:
+            return False, ("halo_dtype is a GCN lever; GAT narrows via its "
+                           "table forms (compute_dtype)")
+        if m.delta:
+            return False, "halo_delta requires halo_staleness=1 (GCN only)"
+        if m.gat_form not in GAT_FORMS:
+            return False, f"unknown GAT table form {m.gat_form!r}"
+    else:
+        if m.gat_form is not None:
+            return False, "gat_form is a GAT axis"
+    if m.delta and not m.staleness:
+        return False, "halo_delta accumulates into the stale halo carry"
+    if m.workload in ("serve", "minibatch") and (m.staleness or m.delta):
+        return False, ("staleness/delta are full-batch TRAINING levers; "
+                       "serving always runs the exact forward and the "
+                       "mini-batch trainer re-plans per batch")
+    if m.workload == "minibatch" and m.model == "gat":
+        # supported by the trainer, but the audit covers the mini-batch
+        # envelope once (GCN) — the GAT program is the same per-layer
+        # structure already audited full-batch
+        return False, "mini-batch audit entry covers the GCN envelope"
+    if m.workload == "serve" and m.gat_form == "packed":
+        return False, ("the serve engine has no compute_dtype lever — the "
+                       "packed form is a training-side wire shape")
+    return True, "supported"
+
+
+def supported_modes() -> list[Mode]:
+    """Every supported configuration, audited by ``hlo_audit.run_audit``.
+
+    Enumerates the FULL cross product per workload and filters through
+    ``is_supported`` — so adding an axis value here automatically widens
+    the audit, and a combination silently missing from the output is a
+    bug in ``is_supported``, not in a hand-maintained list.
+    """
+    modes: list[Mode] = []
+    # train / GCN: schedule × staleness × halo-dtype × delta
+    for sched, stale, hd, delta in itertools.product(
+            ("a2a", "ragged"), (0, 1), (None, "bfloat16"), (False, True)):
+        modes.append(Mode("train", "gcn", sched, stale, hd, delta))
+    # train / GAT: schedule × table form
+    for sched, form in itertools.product(("a2a", "ragged"), GAT_FORMS):
+        modes.append(Mode("train", "gat", sched, gat_form=form))
+    # serve: model × schedule (× halo-dtype for GCN, × form for GAT)
+    for sched, hd in itertools.product(("a2a", "ragged"),
+                                       (None, "bfloat16")):
+        modes.append(Mode("serve", "gcn", sched, halo_dtype=hd))
+    for sched in ("a2a", "ragged"):
+        modes.append(Mode("serve", "gat", sched, gat_form="fused"))
+    # the mini-batch shared-envelope program (one entry: the envelope padding
+    # and forced ragged round sizes are what differ from full-batch)
+    modes.append(Mode("minibatch", "gcn", "ragged"))
+    return [m for m in modes if is_supported(m)[0]]
+
+
+def fast_modes() -> list[Mode]:
+    """The ``--fast`` subset: one exact mode, one composed mode — enough to
+    smoke the whole lower-and-check pipeline in a couple of lowers."""
+    return [
+        Mode("train", "gcn", "a2a"),
+        Mode("train", "gcn", "ragged", staleness=1,
+             halo_dtype="bfloat16"),
+    ]
+
+
+def train_matrix_verdicts() -> dict:
+    """The ``docs/comm_schedule.md`` composition-matrix rows (schedule ×
+    staleness × delta × model) as enumerator verdicts — the machine-readable
+    face of that table.  ``tests/test_analysis.py`` pins the two against
+    each other."""
+    out = {}
+    for sched, stale, delta, model in itertools.product(
+            ("a2a", "ragged"), (0, 1), (False, True), ("gcn", "gat")):
+        mode = Mode("train", model, sched, stale, None, delta,
+                    gat_form="fused" if model == "gat" else None)
+        ok, reason = is_supported(mode)
+        out[(sched, stale, delta, model)] = (ok, reason)
+    return out

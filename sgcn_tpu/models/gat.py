@@ -457,6 +457,25 @@ def _use_packed(dtype, fout: int) -> bool:
     return dtype == jnp.bfloat16 and fout % 2 == 0
 
 
+def gat_table_form(fout: int, compute_dtype=None) -> str:
+    """The table form one GAT exchange ships at width ``fout`` —
+    ``'fused'`` (one ``(·, fout+1)`` table), ``'split'`` (feature rows +
+    scalar as separate dense dispatches / one two-lane ring) or
+    ``'packed'`` (the bit-paired ``(·, fout/2+1)`` f32 table of the bf16
+    compute path).  THE shared encoding of the layer's dispatch selection
+    (``_gat_factored_fwd_core`` / ``_gat_layer_sym_bwd`` branch on it, both
+    directions ship the same form) — the static-analysis collective census
+    (``sgcn_tpu/analysis``) derives the expected per-exchange dispatch
+    count and wire shape from it, so the forward cannot change form
+    without the HLO audit noticing.  ``compute_dtype`` accepts the
+    trainer-level string, a jnp/np dtype, or ``None`` (f32)."""
+    bf16 = (compute_dtype is not None
+            and jnp.dtype(compute_dtype) == jnp.bfloat16)
+    if _use_packed(jnp.bfloat16 if bf16 else jnp.float32, fout):
+        return "packed"
+    return "fused" if _fused_form(fout) else "split"
+
+
 def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
                            ctail_dst, ctail_src, ctail_w, row_valid, buckets,
                            axis_name, comm=COMM_A2A):
@@ -473,7 +492,8 @@ def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
     # the general path autodiff through this core (pmax has no diff rule)
     cg = jax.lax.pmax(jax.lax.stop_gradient(jnp.max(z2m)), axis_name)
     u = jnp.exp(z2.astype(jnp.float32) - cg)         # (B,) in (0, 1]
-    if _use_packed(z.dtype, fout):
+    form = gat_table_form(fout, z.dtype)
+    if form == "packed":
         # bf16 compute: ONE gather per edge carries [u·z ‖ u] bit-packed
         p16 = u.astype(jnp.bfloat16)[:, None] * z
         num, den = _packed_aggregate(
@@ -483,7 +503,7 @@ def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
         # table stays in the compute dtype (bf16 under mixed precision,
         # halving exchange bytes); u itself is f32 for stabilizer exactness
         p = u.astype(z.dtype)[:, None] * z           # (B, fout)
-        if _fused_form(fout):
+        if form == "fused":
             table = jnp.concatenate([p, u.astype(z.dtype)[:, None]], axis=-1)
             halo = _exchange_table(table, send_idx, halo_src, axis_name,
                                    comm)
@@ -537,12 +557,13 @@ def _gat_layer_sym_bwd(buckets, axis_name, comm, res, gbar):
     # j, Σ_i mask_ij·dn_i over j's in-edge slots (aggregators of j) — the
     # backward's [ḡ/D ‖ −(ḡ·out)/D] table rides the SAME transport (comm)
     # as the forward's, so the ragged ring carries both directions
-    if _use_packed(z.dtype, fout):
+    form = gat_table_form(fout, z.dtype)
+    if form == "packed":
         dp, du_agg = _packed_aggregate(
             dn.astype(jnp.bfloat16), dd, fout, send_idx, halo_src,
             cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets, b,
             axis_name, comm)
-    elif _fused_form(fout):
+    elif form == "fused":
         table = jnp.concatenate([dn, dd[:, None]], axis=-1)
         halo = _exchange_table(table, send_idx, halo_src, axis_name, comm)
         full = jnp.concatenate([table, halo], axis=0)
@@ -745,4 +766,13 @@ def gat_forward_local(
             pa["ctail_dst"], pa["ctail_src"], pa["ctail_w"],
             pa["row_valid"], cell_buckets, axis_name, comm)
         h = fact(h) if i == nl - 1 else act(h)
+        if i < nl - 1:
+            # the softmax-weighted aggregation accumulates in f32 and
+            # returns f32 rows; under mixed precision the NEXT layer must
+            # see the compute dtype again or every layer past the first
+            # silently runs the full-width f32 table forms — an f32 wire
+            # under a bf16 request that no loss-parity test notices (found
+            # by the sgcn_tpu/analysis wire audit; the byte gauges'
+            # gat_exchange_lane_widths always assumed all layers narrow)
+            h = h.astype(p["w"].dtype)
     return h

@@ -139,6 +139,18 @@ def halo_exchange(h, send_idx, halo_src, axis_name: str = AXIS,
     return jnp.take(flat, halo_src, axis=0).astype(h.dtype)  # (R, f)
 
 
+def ragged_live_rounds(rr_sizes) -> tuple:
+    """Ring distances d (1-based) of the rounds with ``S_d > 0`` — exactly
+    the rounds that EXIST in a traced ragged-schedule program (every loop
+    below skips ``S_d = 0`` rounds, so they vanish at trace time: no
+    ppermute, no buffer, no fold step).  The single shared encoding of that
+    elision rule: the ragged ops here iterate it, and the static-analysis
+    collective census (``sgcn_tpu/analysis``) derives its expected
+    ``collective_permute`` count per exchange from it — change one without
+    the other and the HLO audit fails the commit."""
+    return tuple(d for d, sd in enumerate(rr_sizes, start=1) if sd > 0)
+
+
 def ppermute_or_identity(buf, axis_name: str, d: int):
     """Round-``d`` ring shift of the ragged schedule: chip ``p`` sends
     ``buf`` to chip ``(p+d) % k`` (so each chip receives from ``(p−d) % k``)
@@ -182,9 +194,11 @@ def halo_exchange_ragged_multi(parts, rsend_idx, rhalo_dst, rr_sizes, r: int,
     """
     lanes = [p.shape[1] if p.ndim == 2 else 1 for p in parts]
     halos = [jnp.zeros((r,) + p.shape[1:], p.dtype) for p in parts]
+    live = ragged_live_rounds(rr_sizes)
     off = 0
     for d, sd in enumerate(rr_sizes, start=1):
-        if sd == 0:
+        if d not in live:
+            off += sd      # keep slice bookkeeping right under ANY rule
             continue
         idx = rsend_idx[off: off + sd]
         bufs = [jnp.take(p, idx, axis=0) for p in parts]
@@ -435,9 +449,11 @@ def _ragged_remote(x, rsend_idx, redge_dst, redge_src, redge_w,
                    halo_dtype):
     """Σ_d (round-d scatter-add of Â_halo·recv_d) over the ppermute ring."""
     remote = jnp.zeros((num_rows, x.shape[-1]), x.dtype)
+    live = ragged_live_rounds(rr_sizes)
     off_s = off_e = 0
     for d, (sd, ed) in enumerate(zip(rr_sizes, rr_edge_sizes), start=1):
-        if sd == 0:                       # no pair at this ring distance
+        if d not in live:                 # no pair at this ring distance
+            off_s += sd   # keep slice bookkeeping right under ANY rule
             off_e += ed
             continue
         buf = jnp.take(x, rsend_idx[off_s: off_s + sd], axis=0)  # (S_d, f)
@@ -676,9 +692,11 @@ def _stale_ragged_exchange(x, halo_in, base_in, rsend_idx, rr_sizes,
     exact-mode ring's wire, so a full-sync step receives the exact ragged
     exchange's bits."""
     segs_h, segs_b = [], []
+    live = ragged_live_rounds(rr_sizes)
     off = 0
     for d, sd in enumerate(rr_sizes, start=1):
-        if sd == 0:
+        if d not in live:
+            off += sd      # keep slice bookkeeping right under ANY rule
             continue
         full = jnp.take(x, rsend_idx[off: off + sd], axis=0)   # (S_d, f)
         if delta and not fresh:
@@ -715,9 +733,11 @@ def _stale_ragged_fold(halo_tab, redge_dst, redge_src, redge_w,
     table instead of this step's wire — same per-slot addition sequence,
     so consuming a FRESH carry reproduces the exact ragged path's bits."""
     remote = jnp.zeros((num_rows, halo_tab.shape[-1]), halo_tab.dtype)
+    live = ragged_live_rounds(rr_sizes)
     off_s = off_e = 0
-    for sd, ed in zip(rr_sizes, rr_edge_sizes):
-        if sd == 0:
+    for d, (sd, ed) in enumerate(zip(rr_sizes, rr_edge_sizes), start=1):
+        if d not in live:
+            off_s += sd   # keep slice bookkeeping right under ANY rule
             off_e += ed
             continue
         recv = halo_tab[off_s: off_s + sd]

@@ -325,6 +325,32 @@ class CommPlan:
             return int(rows * sum(sizes))
         raise ValueError(f"unknown comm schedule {schedule!r}")
 
+    def wire_buffer_shapes(self, schedule: str = "a2a") -> list:
+        """Static per-DISPATCH wire-buffer shapes of ONE halo exchange,
+        WITHOUT the trailing lane axis (the per-layer table width is the
+        model's business — ``models.gcn.exchange_widths`` /
+        ``models.gat.gat_exchange_lane_widths``).
+
+        ``'a2a'``: one dispatch of the globally-padded ``(peers, S)`` bucket
+        per exchange.  ``'ragged'``: one dispatch of ``(S_d,)`` per LIVE
+        round (``ops.pspmm.ragged_live_rounds`` — empty rounds ship nothing
+        and vanish from the traced program).  This is the shape side of the
+        compiled-program wire contract the HLO audit
+        (``sgcn_tpu/analysis``) checks against every lowered step.
+        """
+        if schedule == "a2a":
+            peers = int(np.asarray(self.send_counts).shape[1])
+            return [(peers, self.s)]
+        if schedule == "ragged":
+            # deferred: ops.pspmm imports jax; this module stays numpy-only
+            from ..ops.pspmm import ragged_live_rounds
+
+            sizes = (self.rr_sizes if self.rr_sizes is not None
+                     else self.ragged_round_sizes())
+            return [(int(sizes[d - 1]),)
+                    for d in ragged_live_rounds(sizes)]
+        raise ValueError(f"unknown comm schedule {schedule!r}")
+
     def ensure_ragged(self, rr_sizes: tuple | None = None,
                       rr_edge_sizes: tuple | None = None) -> "CommPlan":
         """Build the ragged ppermute-ring layout on first use.

@@ -169,8 +169,15 @@ class ServeEngine:
         self._h0 = shard_stacked(self.mesh, h0)
 
     # ------------------------------------------------------------- compile
-    def _build(self, q: int):
-        """AOT-compile the bucket-``q`` forward+gather program."""
+    def lower_bucket(self, q: int):
+        """AOT-LOWER the bucket-``q`` forward+gather program (no compile,
+        no execution) — the serve entry point of the static-analysis HLO
+        audit (``sgcn_tpu/analysis``): the lowered module is exactly the
+        program ``_ensure_compiled(q)`` compiles, so the audit checks the
+        real serving step's collective census (L halo exchanges + ONE
+        logit-gather psum), wire dtypes and the no-donation contract
+        (engine params are reused across batches — a donated buffer here
+        would be a use-after-free by design)."""
         import jax
         from jax import lax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -216,12 +223,11 @@ class ServeEngine:
         h0_s = jax.ShapeDtypeStruct((self.plan.k, self.plan.b, self.fin),
                                     np.dtype(np.float32), sharding=shd)
         qs = jax.ShapeDtypeStruct((q,), np.dtype(np.int32), sharding=rep)
-        lowered = jax.jit(smapped).lower(params_s, pa_s, h0_s, qs, qs)
-        return lowered.compile()
+        return jax.jit(smapped).lower(params_s, pa_s, h0_s, qs, qs)
 
     def _ensure_compiled(self, q: int):
         if q not in self._compiled:
-            self._compiled[q] = self._build(q)
+            self._compiled[q] = self.lower_bucket(q).compile()
             self.compile_count += 1
         return self._compiled[q]
 

@@ -764,21 +764,48 @@ class FullBatchTrainer:
         )
         return jax.jit(smapped, donate_argnums=(0, 1))
 
-    def lower_step(self, mesh, fin: int):
-        """AOT-lower ONE train step for an arbitrary mesh — including a
-        device-less ``jax.experimental.topologies`` mesh (e.g. an 8-chip v5e
-        slice this host does not have).  Inputs are ShapeDtypeStructs shaped
-        like this trainer's live arrays, so the lowered module is exactly the
-        program ``step()`` runs, just targeted at the given topology.
+    def lower_step(self, mesh=None, fin: int | None = None,
+                   kind: str = "step"):
+        """AOT-lower ONE train step — no compilation, no execution.
 
-        Used by the overlap evidence test (``tests/test_overlap_hlo.py``) to
-        compile the real multi-chip TPU program and assert the async
-        all-to-all start/done schedule brackets the local slot passes —
-        the compiled-schedule form of the reference's Irecv/compute/Waitany
-        overlap (``Parallel-GCN/main.c:238-299``) that does not need 8
-        physical chips to demonstrate."""
+        ``mesh`` may be an arbitrary mesh, including a device-less
+        ``jax.experimental.topologies`` mesh (e.g. an 8-chip v5e slice this
+        host does not have); ``None`` uses the trainer's own mesh.  Inputs
+        are ShapeDtypeStructs shaped like this trainer's live arrays, so
+        the lowered module is exactly the program ``step()`` runs, just
+        targeted at the given topology.
+
+        ``kind`` selects which of the trainer's step programs to lower:
+        ``'step'`` the exact-mode step; ``'stale'`` / ``'sync'`` the
+        pipelined stale-mode step and its periodic full-sync flavor
+        (``halo_staleness=1`` trainers only; these include the halo-carry
+        inputs and lower on the trainer's own mesh — the stale builders are
+        mesh-bound).
+
+        Two consumers: the overlap evidence test
+        (``tests/test_overlap_hlo.py``) compiles the real multi-chip TPU
+        program and asserts the async all-to-all start/done schedule
+        brackets the local slot passes — the compiled-schedule form of the
+        reference's Irecv/compute/Waitany overlap
+        (``Parallel-GCN/main.c:238-299``); and the static-analysis HLO
+        audit (``sgcn_tpu/analysis``) lowers every supported mode on the
+        virtual 8-dev mesh and checks the collective census / wire dtype /
+        donation contracts of the lowered module."""
         from jax.sharding import NamedSharding
 
+        if kind not in ("step", "stale", "sync"):
+            raise ValueError(f"unknown step kind {kind!r}")
+        if kind != "step":
+            if not self.halo_staleness:
+                raise ValueError(
+                    f"kind={kind!r} lowers the stale-mode programs; this "
+                    "trainer runs exact mode (halo_staleness=0)")
+            if mesh not in (None, self.mesh):
+                raise ValueError(
+                    "stale step programs are built against the trainer's "
+                    "own mesh; pass mesh=None for kind='stale'/'sync'")
+        mesh = self.mesh if mesh is None else mesh
+        fin = self.fin if fin is None else fin
         rep = NamedSharding(mesh, P())
         shd = NamedSharding(mesh, P(AXIS))
         k, b = self.plan.k, self.plan.b
@@ -792,6 +819,11 @@ class FullBatchTrainer:
         h0 = jax.ShapeDtypeStruct((k, b, fin), np.float32, sharding=shd)
         labels = jax.ShapeDtypeStruct((k, b), np.int32, sharding=shd)
         valid = jax.ShapeDtypeStruct((k, b), np.float32, sharding=shd)
+        if kind != "step":
+            carry = jax.tree.map(lambda x: sds(x, shd), self.halo_carry)
+            prog = self._step_stale if kind == "stale" else self._step_sync
+            return prog.lower(params, opt_state, carry, pa, h0, labels,
+                              valid)
         return self._build_step(mesh=mesh).lower(
             params, opt_state, pa, h0, labels, valid)
 

@@ -251,6 +251,16 @@ class MiniBatchTrainer:
         return out
 
     # ------------------------------------------------------------------- api
+    def lower_step(self):
+        """AOT-lower the ONE shared-envelope train step every batch runs
+        (no compile, no execution) — the mini-batch entry point of the
+        static-analysis HLO audit (``sgcn_tpu/analysis``): the program
+        ``step(batch)`` dispatches is the inner trainer's step over the
+        padded batch envelope (shared B/S/R/E + ragged round sizes), so its
+        collective census / wire dtype / donation contracts are audited on
+        exactly that envelope."""
+        return self.inner.lower_step()
+
     def step(self, batch: Batch) -> float:
         tr = self.inner
         # under a recorder, the step span brackets dispatch AND the loss

@@ -203,6 +203,23 @@ def test_train_cli_checkpoint_resume(pipeline, tmp_path):
     assert first_resumed < first_fresh
 
 
+def test_analysis_cli_fast_smoke():
+    """``python -m sgcn_tpu.analysis --fast --json``: the AST hygiene pass
+    plus the 2-mode HLO smoke subset, emitting the schema-validated JSON
+    report on stdout with rc 0 — the CI face of the static-analysis
+    subsystem (the full matrix runs in tests/test_analysis.py)."""
+    r = run_cli(["sgcn_tpu.analysis", "--fast", "--json"])
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["schema"] == "sgcn_analysis_report" and rep["ok"] is True
+    assert rep["fast"] is True
+    assert rep["hlo"]["n_modes"] == 2 and rep["hlo"]["ok"] is True
+    assert set(rep["ast"]["rules"]) == {
+        "traced-host-free", "sanctioned-sync-only", "consumer-registered",
+        "mode-flag-enumerated"}
+    assert all(e["ok"] for e in rep["ast"]["rules"].values())
+
+
 def test_package_dispatcher_lists_tools():
     r = run_cli(["sgcn_tpu"])
     assert r.returncode == 0, r.stderr

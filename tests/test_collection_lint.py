@@ -30,7 +30,9 @@ TESTS = os.path.dirname(os.path.abspath(__file__))
 # that is the point of the lint.
 SUBPROCESS_BUDGET_ALLOWLIST = {
     "test_cli.py": "end-to-end file-pipeline CLIs on a 150-vertex graph; "
-                   "~10 children, each seconds on the forced-CPU backend",
+                   "~10 children, each seconds on the forced-CPU backend, "
+                   "plus the sgcn_tpu.analysis --fast smoke (2-mode HLO "
+                   "subset, ~15 s)",
     "test_multihost.py": "2-process x 4-vdev rendezvous on a 48-vertex "
                          "graph — the only multi-process coverage tier-1 has",
     "test_import_ogb.py": "offline importer script on a tiny synthetic "
@@ -50,15 +52,39 @@ SUBPROCESS_BUDGET_ALLOWLIST = {
                      "24 queries, one compiled bucket; ~1 min)",
 }
 
+# Modules that run the static-analysis MATRIX auditor
+# (sgcn_tpu.analysis.hlo_audit.run_audit — a full run lowers every
+# supported mode's real program, ~75 s at HEAD and growing with the
+# matrix): same reviewed-budget contract as the subprocess allowlist.  A
+# single one-program .lower() is cheap and not gated; the matrix sweep is
+# the class that can silently eat the tier-1 budget as modes are added.
+MATRIX_AUDIT_BUDGET_ALLOWLIST = {
+    "test_analysis.py": "ONE module-scoped full-matrix run (~75 s at "
+                        "HEAD, 27 mode entries, lowering only — no "
+                        "compile/execute) shared by every matrix "
+                        "assertion, plus per-mode mutation audits "
+                        "(~2-4 s each)",
+    "test_cli.py": "the analysis CLI smoke child runs --fast (2 modes), "
+                   "never the full matrix",
+}
+
+# matches ANY invocation of the auditor — in-process (run_audit) or the
+# CLI in either flavor: a full-matrix CLI child is exactly the expensive
+# case this lint exists to catch, so --fast must NOT be required to match
+# (the allowlist notes say which flavor each entry is budgeted for).  The
+# lookahead excludes plain SUBMODULE imports (sgcn_tpu.analysis.registry
+# etc. — cheap, no audit); naming the package itself (the `-m` CLI form
+# or a package import) still matches.
+_MATRIX_AUDIT_RE = re.compile(r"run_audit\(|sgcn_tpu\.analysis(?![.\w])")
+
 _SPAWN_RE = re.compile(
     r"subprocess\.(run|Popen|check_output|check_call)"
     r"|dryrun_multichip\(|_run_vdev_child\(")
 
 
-def _module_spawns_subprocesses(path: str) -> bool:
+def _module_matches(path: str, pattern: re.Pattern) -> bool:
     with open(path) as fh:
-        src = fh.read()
-    return bool(_SPAWN_RE.search(src))
+        return bool(pattern.search(fh.read()))
 
 
 def _module_has_slow_marker(path: str) -> bool:
@@ -67,19 +93,43 @@ def _module_has_slow_marker(path: str) -> bool:
     return "mark.slow" in src
 
 
-def test_subprocess_mesh_tests_are_slow_marked_or_budgeted():
+def _budget_lint_offenders(pattern: re.Pattern, allowlist: dict) -> list:
+    """ONE implementation of the budget lint walk (subprocess meshes AND
+    matrix-audit sweeps ride it): modules matching ``pattern`` must be
+    slow-marked or allowlisted.  This module itself is excluded — it NAMES
+    the patterns."""
     offenders = []
     for name in sorted(os.listdir(TESTS)):
         if not (name.startswith("test_") and name.endswith(".py")):
             continue
-        path = os.path.join(TESTS, name)
-        if not _module_spawns_subprocesses(path):
+        if name == os.path.basename(__file__):
             continue
-        if name in SUBPROCESS_BUDGET_ALLOWLIST:
+        path = os.path.join(TESTS, name)
+        if not _module_matches(path, pattern):
+            continue
+        if name in allowlist:
             continue
         if _module_has_slow_marker(path):
             continue
         offenders.append(name)
+    return offenders
+
+
+def _assert_allowlist_live(pattern: re.Pattern, allowlist: dict,
+                           what: str) -> None:
+    """A stale allowlist is its own hygiene failure: every entry must name
+    a live module that still matches (else the entry is dead weight
+    masking future regressions)."""
+    for name in allowlist:
+        path = os.path.join(TESTS, name)
+        assert os.path.exists(path), f"allowlisted {name} no longer exists"
+        assert _module_matches(path, pattern), (
+            f"allowlisted {name} no longer {what} — drop the entry")
+
+
+def test_subprocess_mesh_tests_are_slow_marked_or_budgeted():
+    offenders = _budget_lint_offenders(_SPAWN_RE,
+                                       SUBPROCESS_BUDGET_ALLOWLIST)
     assert not offenders, (
         f"test modules {offenders} spawn subprocess meshes but carry no "
         "@pytest.mark.slow and are not in SUBPROCESS_BUDGET_ALLOWLIST — "
@@ -87,16 +137,28 @@ def test_subprocess_mesh_tests_are_slow_marked_or_budgeted():
         "a measured tier-1 budget justification")
 
 
+def test_matrix_audit_tests_are_slow_marked_or_budgeted():
+    """The PR-9 extension of this lint: a module invoking the mode-matrix
+    auditor carries a slow mark or a reviewed budget justification — the
+    audit's cost scales with the supported matrix, so a new audit-driven
+    test is a conscious budget decision exactly like a subprocess mesh."""
+    offenders = _budget_lint_offenders(_MATRIX_AUDIT_RE,
+                                       MATRIX_AUDIT_BUDGET_ALLOWLIST)
+    assert not offenders, (
+        f"test modules {offenders} run the static-analysis matrix auditor "
+        "but carry no @pytest.mark.slow and are not in "
+        "MATRIX_AUDIT_BUDGET_ALLOWLIST — the matrix sweep's cost grows "
+        "with every supported mode; budget it consciously")
+
+
+def test_matrix_audit_allowlist_entries_exist_and_audit():
+    _assert_allowlist_live(_MATRIX_AUDIT_RE, MATRIX_AUDIT_BUDGET_ALLOWLIST,
+                           "runs the matrix auditor")
+
+
 def test_allowlist_entries_exist_and_spawn():
-    """A stale allowlist is its own hygiene failure: every entry must name a
-    live module that still spawns subprocesses (else the entry is dead
-    weight masking future regressions)."""
-    for name in SUBPROCESS_BUDGET_ALLOWLIST:
-        path = os.path.join(TESTS, name)
-        assert os.path.exists(path), f"allowlisted {name} no longer exists"
-        assert _module_spawns_subprocesses(path), (
-            f"allowlisted {name} no longer spawns subprocesses — drop the "
-            "entry")
+    _assert_allowlist_live(_SPAWN_RE, SUBPROCESS_BUDGET_ALLOWLIST,
+                           "spawns subprocesses")
 
 
 def test_runtime_budget_hook_active():

@@ -13,6 +13,10 @@ assert, in the scheduled HLO, that the halo ``all-to-all`` compiles to async
 ``-start``/``-done`` pairs with real compute (fusions — the local slot
 passes) scheduled inside the start→done window.  That is the compiled-program
 form of "communication overlaps local aggregation".
+
+HLO parsing rides the repo's ONE parser (``sgcn_tpu.analysis.hlo`` — the
+same module the mode-matrix auditor uses on lowered StableHLO), so the
+start/done pairing logic cannot drift between this test and the audit.
 """
 
 import re
@@ -20,6 +24,7 @@ import re
 import numpy as np
 import pytest
 
+from sgcn_tpu.analysis import hlo
 from sgcn_tpu.parallel import build_comm_plan
 from sgcn_tpu.partition import balanced_random_partition
 from sgcn_tpu.train import FullBatchTrainer
@@ -68,28 +73,15 @@ def step_text(v5e_mesh, n=4096, avg_deg=12, f=64):
 
 
 def test_halo_all_to_all_is_async_and_overlapped(step_text):
-    lines = step_text.splitlines()
     # pair each async start with ITS done via the SSA value name:
     #   %all-to-all-start.N = ... all-to-all-start(...)
     #   %all-to-all-done.M  = ... all-to-all-done(%all-to-all-start.N)
-    starts = {}
-    for i, ln in enumerate(lines):
-        m = re.match(r"\s*(%all-to-all-start[\w.\-]*) = ", ln)
-        if m:
-            starts[m.group(1)] = i
-    assert len(starts) >= 2, (
-        f"no async all-to-all pairs in schedule ({len(starts)} starts) — "
-        "was the program compiled with xla_tpu_enable_async_all_to_all?")
-    windows = []
-    for i, ln in enumerate(lines):
-        m = re.search(r"all-to-all-done[\w.\-]*\(([^)]*)\)", ln)
-        if m:
-            src = m.group(1).split(",")[0].strip()
-            assert src in starts, f"done consumes unknown start {src!r}"
-            s = starts.pop(src)
-            inside = sum("fusion(" in x for x in lines[s + 1: i])
-            windows.append(inside)
-    assert not starts, f"unmatched all-to-all-start(s): {list(starts)}"
+    # (hlo.async_windows raises on an unknown-start done or an unmatched
+    # start — a malformed schedule must fail loudly, not read as zero)
+    assert hlo.count_async_starts(step_text) >= 2, (
+        "no async all-to-all pairs in schedule — was the program compiled "
+        "with xla_tpu_enable_async_all_to_all?")
+    windows = hlo.async_windows(step_text)
     # Every layer's local-src slot pass is independent of its own exchange
     # by construction (ops/pspmm.py::pspmm_overlap), so the latency-hiding
     # scheduler must put real compute inside every real exchange window.

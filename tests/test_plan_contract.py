@@ -7,37 +7,39 @@ classified in ``PER_CHIP_ARRAY_FIELDS`` mis-slices (or loudly fails) under
 exists only explodes at trainer-construction time deep in a run.  This lint
 fails the commit that introduces either skew — including for the ragged
 exchange fields, covered from day one.
+
+The registry of consumer tuples lives in ``sgcn_tpu.analysis.registry``
+(PR-9 consolidation): this test validates its entries against the
+dataclass and the shard proxy, and the AST hygiene pass
+(``analysis.ast_rules``) fails any NEW ``*_FIELDS*`` tuple that is not
+registered there — so a tuple cannot exist outside this lint's sight.
 """
 
 import dataclasses
 
 import numpy as np
 
+from sgcn_tpu.analysis.registry import resolve_consumer_tuples
+
+_REGISTRY = resolve_consumer_tuples()
 from sgcn_tpu.io.datasets import er_graph
-from sgcn_tpu.models.gat import GAT_PLAN_FIELDS, GAT_PLAN_FIELDS_RAGGED
-from sgcn_tpu.models.gcn import (GCN_PLAN_FIELDS_GEN, GCN_PLAN_FIELDS_RAGGED,
-                                 GCN_PLAN_FIELDS_SYM)
-from sgcn_tpu.ops.pallas_spmm import PALLAS_PLAN_FIELDS
 from sgcn_tpu.parallel import build_comm_plan
 from sgcn_tpu.parallel.plan import (_GLOBAL_ARRAY_FIELDS,
-                                    PER_CHIP_ARRAY_FIELDS,
-                                    STALE_PLAN_FIELDS_RAGGED, CommPlan)
+                                    PER_CHIP_ARRAY_FIELDS, CommPlan)
 from sgcn_tpu.partition import balanced_random_partition
 from sgcn_tpu.prep import normalize_adjacency
-from sgcn_tpu.serve.router import SERVE_ROUTER_FIELDS
 
-# every tuple that names CommPlan fields for shipping/slicing, in one place
+SERVE_ROUTER_FIELDS = _REGISTRY["SERVE_ROUTER_FIELDS"]
+GAT_PLAN_FIELDS_RAGGED = _REGISTRY["GAT_PLAN_FIELDS_RAGGED"]
+STALE_PLAN_FIELDS_RAGGED = _REGISTRY["STALE_PLAN_FIELDS_RAGGED"]
+GCN_PLAN_FIELDS_RAGGED = _REGISTRY["GCN_PLAN_FIELDS_RAGGED"]
+
+# the registry's consumer tuples plus the two classification tuples —
+# everything below validates THESE entries (one dict, one home)
 CONSUMER_TUPLES = {
     "PER_CHIP_ARRAY_FIELDS": PER_CHIP_ARRAY_FIELDS,
     "_GLOBAL_ARRAY_FIELDS": _GLOBAL_ARRAY_FIELDS,
-    "PALLAS_PLAN_FIELDS": PALLAS_PLAN_FIELDS,
-    "GAT_PLAN_FIELDS": GAT_PLAN_FIELDS,
-    "GAT_PLAN_FIELDS_RAGGED": GAT_PLAN_FIELDS_RAGGED,
-    "GCN_PLAN_FIELDS_SYM": GCN_PLAN_FIELDS_SYM,
-    "GCN_PLAN_FIELDS_GEN": GCN_PLAN_FIELDS_GEN,
-    "GCN_PLAN_FIELDS_RAGGED": GCN_PLAN_FIELDS_RAGGED,
-    "STALE_PLAN_FIELDS_RAGGED": STALE_PLAN_FIELDS_RAGGED,
-    "SERVE_ROUTER_FIELDS": SERVE_ROUTER_FIELDS,
+    **_REGISTRY,
 }
 
 

@@ -229,3 +229,83 @@ def test_validator_cli_exit_codes(tmp_path):
                        capture_output=True, text=True, timeout=120)
     assert r.returncode == 1
     assert "violation" in r.stdout
+
+
+def _clean_analysis_report(n_modes=30):
+    modes = {
+        f"train/gcn/a2a/s0/m{i}": {
+            "ok": True,
+            "programs": {"step": {"ok": True, "violations": [],
+                                  "census": {"all_to_all": 3}}},
+        } for i in range(n_modes)
+    }
+    return {
+        "schema": "sgcn_analysis_report", "v": 1, "fast": False,
+        "ok": True,
+        "hlo": {"modes": modes, "n_modes": n_modes, "ok": True},
+        "ast": {"rules": {"traced-host-free": {"ok": True,
+                                               "violations": []}},
+                "ok": True},
+    }
+
+
+def test_validator_accepts_clean_analysis_report():
+    from validate_bench import check_analysis_report
+
+    assert not check_analysis_report(_clean_analysis_report())
+
+
+def test_validator_rejects_red_or_fast_analysis_report():
+    from validate_bench import check_analysis_report
+
+    rec = _clean_analysis_report()
+    rec["ok"] = False
+    assert any("red report" in e for e in check_analysis_report(rec))
+    rec = _clean_analysis_report()
+    rec["fast"] = True
+    assert any("FULL-matrix" in e for e in check_analysis_report(rec))
+
+
+def test_validator_rejects_inconsistent_analysis_report():
+    """The hand-edit tells: an ok flag contradicting its own violation
+    list, a shrunk matrix, an n_modes count that disagrees with the
+    entries."""
+    from validate_bench import check_analysis_report
+
+    rec = _clean_analysis_report()
+    mid = next(iter(rec["hlo"]["modes"]))
+    rec["hlo"]["modes"][mid]["programs"]["step"]["violations"] = [
+        {"rule": "wire-dtype", "detail": "seeded"}]
+    assert any("contradicts" in e for e in check_analysis_report(rec))
+
+    rec = _clean_analysis_report(n_modes=5)
+    assert any("floor" in e for e in check_analysis_report(rec))
+
+    rec = _clean_analysis_report()
+    rec["hlo"]["n_modes"] = 999
+    assert any("inconsistent" in e for e in check_analysis_report(rec))
+
+    rec = _clean_analysis_report()
+    rec["ast"]["rules"]["traced-host-free"]["ok"] = False
+    assert any("ast.rules" in e for e in check_analysis_report(rec))
+
+
+def test_validator_rejects_hand_flipped_top_level_ok():
+    """The one-line hand-edit: a mode entry is red (ok:false WITH recorded
+    violations — internally consistent) but the top-level ok/hlo.ok were
+    flipped green.  Green-only must hold per entry."""
+    from validate_bench import check_analysis_report
+
+    rec = _clean_analysis_report()
+    mid = next(iter(rec["hlo"]["modes"]))
+    entry = rec["hlo"]["modes"][mid]
+    entry["ok"] = False
+    entry["programs"]["step"]["ok"] = False
+    entry["programs"]["step"]["violations"] = [
+        {"rule": "wire-dtype", "detail": "f32 wire under bf16"}]
+    assert any("green in every mode" in e
+               for e in check_analysis_report(rec))
+    rec["ast"]["rules"]["traced-host-free"] = {
+        "ok": False, "violations": ["x"]}
+    assert any("green in every rule" in e
+               for e in check_analysis_report(rec))
