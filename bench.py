@@ -906,6 +906,180 @@ def bench_ragged_stale_ab_child(ahat, feats, labels, widths, epochs: int,
     }
 
 
+def bench_replica_ab(n: int, avg_deg: int, f: int, widths, epochs: int,
+                     graph: str = "ba"):
+    """A/B hot-halo replication (``--replica-budget``) against the
+    no-replica trainer on the 8-virtual-device CPU mesh, across one
+    BALANCED (random) and one SKEWED (native cache-aware hp) partition of
+    the same power-law graph — the ``replica_ab_8dev`` block
+    (docs/replication.md).  One child process runs all four arms over
+    shared state; degrades to a marked partial block on child failure."""
+    block: dict = {"replica_ab_8dev": None}
+    try:
+        child = _run_vdev_child(n, avg_deg, f, widths, epochs, graph,
+                                extra_args=("--replica-ab-child",))
+        child.pop("metric", None)
+        child.pop("value", None)
+        block["replica_ab_8dev"] = child
+        return block
+    except subprocess.TimeoutExpired:
+        print("# replica A/B run exceeded its deadline", file=sys.stderr)
+        block["replica_ab_degraded"] = "deadline"
+        return block
+    except Exception as e:                      # noqa: BLE001 — diagnostic path
+        print(f"# replica A/B run failed: {e!r}", file=sys.stderr)
+        block["replica_ab_degraded"] = repr(e)[:200]
+        return block
+
+
+def bench_replica_ab_child(ahat, feats, labels, widths, epochs: int,
+                           graph: str, sync_every: int = 4) -> dict:
+    """One-process replica-vs-no-replica A/B (the ``--replica-ab-child``
+    body).
+
+    Per partition (balanced random, skewed CACHE-AWARE hp — the native
+    driver co-optimizing the cut with the replica budget): one plan, one
+    mesh, both trainers; rep-level PAIRED differentials like every other
+    one-process child.  Both arms dispatch the same step count, so the
+    cumulative CommStats byte gauges are directly comparable — and the
+    asserted figures are exactly the replication contract:
+
+      * ``halo_bytes_true_total`` STRICTLY lower with B>0 on the hp arm
+        (replicated rows genuinely leave the exchange — the CaPGNN
+        before/after metric the ROADMAP names);
+      * average wire rows per STEP strictly lower (shrunken send pads);
+      * the native cache-aware km1 <= the cache-blind driver's partition
+        evaluated under the SAME objective (independent numpy evaluator).
+
+    CPU-mesh epoch speed is reported honestly but never the claim — no
+    ICI, so wire bytes are the TPU-relevant figure."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from sgcn_tpu.parallel import build_comm_plan, make_mesh_1d
+    from sgcn_tpu.parallel.mesh import shard_stacked
+    from sgcn_tpu.partition import (balanced_random_partition,
+                                    partition_hypergraph_colnet,
+                                    partition_hypergraph_colnet_cache)
+    from sgcn_tpu.partition.native import cache_aware_km1
+    from sgcn_tpu.train import FullBatchTrainer, make_train_data
+
+    k = len(jax.devices())
+    n = ahat.shape[0]
+    # budget ~ the hub head of a power-law graph: n/16 rows is a few % of
+    # the vertex set but a double-digit share of Σλ on BA-style skew (hubs
+    # are consumed by most chips), so the A/B demonstrates a real wire win
+    # while the replica tables stay small (RP × L rows per chip)
+    budget = max(64, n // 16)
+    nl = len(widths)
+    out: dict = {"n": n, "graph": graph, "k": k, "model": "gcn",
+                 "replica_budget": budget, "sync_every": sync_every,
+                 "timing": "per-step dispatch, one process, rep-level "
+                           "paired differentials (see paired_differential)"}
+    parts: list[tuple[str, np.ndarray, dict]] = [
+        ("random", balanced_random_partition(n, k, seed=1), {})]
+    if k > 1:
+        # the hp arm trains on the CACHE-AWARE partition; the cache-blind
+        # driver's partition is scored under the SAME objective by the
+        # independent numpy evaluator — the km1 acceptance inequality
+        pv_blind, km1_blind = partition_hypergraph_colnet(ahat, k, seed=0)
+        pv_hp, km1_hp, km1_cache = partition_hypergraph_colnet_cache(
+            ahat, k, budget, seed=0)
+        blind_cache = cache_aware_km1(ahat, pv_blind, budget)
+        if not km1_cache <= blind_cache:
+            raise RuntimeError(
+                f"cache-aware km1 {km1_cache} not <= the cache-blind "
+                f"partition's cache objective {blind_cache}")
+        parts.append(("hp", pv_hp, {
+            "km1": int(km1_hp), "km1_blind": int(km1_blind),
+            "km1_cache_aware": int(km1_cache),
+            "km1_cache_blind_partition": int(blind_cache)}))
+    mesh = make_mesh_1d(k)
+    nep = max(6, epochs)
+    for name, pv, extra in parts:
+        plan = build_comm_plan(ahat, pv, k)
+        data = make_train_data(plan, feats, labels)
+        data = type(data)(**shard_stacked(mesh, vars(data)))
+
+        def arm(b):
+            tr = FullBatchTrainer(plan, fin=feats.shape[1], widths=widths,
+                                  mesh=mesh, replica_budget=b,
+                                  sync_every=sync_every if b else 0)
+
+            def make_run(n_ep):
+                def run():
+                    loss = None
+                    for _ in range(n_ep):
+                        loss = tr.step(data, sync=False)
+                    return float(loss)    # in-order dispatch syncs the run
+                return run
+            return tr, make_run
+
+        tr_none, mk_none = arm(0)
+        tr_rep, mk_rep = arm(budget)
+        # arm-level span (see bench_stale_ab_child: never inside the loop)
+        from sgcn_tpu.obs.tracing import scoped_span
+        with scoped_span(f"bench:replica_ab:{name}", phase="ab_child",
+                         detail=f"n={n} graph={graph} B={budget}"):
+            none_s, rep_s, clean = paired_differential(
+                mk_none, mk_rep, nep, what=f"replica A/B ({name})")
+        rn, rr = tr_none.stats.report(), tr_rep.stats.report()
+        if rn["exchanges"] != rr["exchanges"]:
+            raise RuntimeError(
+                f"replica A/B ({name}): arms ran unequal exchange counts "
+                f"({rn['exchanges']} vs {rr['exchanges']}) — totals not "
+                "comparable")
+        steps = rn["exchanges"] // (2 * nl)
+        cfg = {
+            "epoch_s_noreplica": round(none_s, 6),
+            "epoch_s_replica": round(rep_s, 6),
+            "replica_speedup": round(none_s / rep_s, 3),
+            "clean_pairs": clean,
+            "steps": steps,
+            "replica_rows": int(plan.replica_rows),
+            "replica_send_saving": int(plan.replica_send_saving),
+            "true_rows_per_exchange": rn["true_rows_per_exchange"],
+            "true_rows_per_exchange_replica":
+                rr["true_rows_per_exchange_replica"],
+            "wire_rows_per_exchange": rn["wire_rows_per_exchange"],
+            "wire_rows_per_exchange_replica":
+                rr["wire_rows_per_exchange_replica"],
+            # cumulative over the SAME dispatched step sequence — the
+            # before/after metric of the feature (CaPGNN, ROADMAP item 2)
+            "halo_bytes_true_total_noreplica": rn["halo_bytes_true_total"],
+            "halo_bytes_true_total_replica": rr["halo_bytes_true_total"],
+            "wire_rows_per_step_noreplica": round(
+                rn["wire_rows_total"] / steps, 2),
+            "wire_rows_per_step_replica": round(
+                rr["wire_rows_total"] / steps, 2),
+            **extra,
+        }
+        if name == "hp":
+            # the acceptance inequalities of the feature — STRICT on the
+            # skewed partition (re-checked by scripts/validate_bench.py)
+            if not (cfg["halo_bytes_true_total_replica"]
+                    < cfg["halo_bytes_true_total_noreplica"]):
+                raise RuntimeError(
+                    f"replica A/B (hp): halo_bytes_true_total "
+                    f"{cfg['halo_bytes_true_total_replica']} not below "
+                    f"{cfg['halo_bytes_true_total_noreplica']}")
+            if not (cfg["wire_rows_per_step_replica"]
+                    < cfg["wire_rows_per_step_noreplica"]):
+                raise RuntimeError(
+                    f"replica A/B (hp): wire rows/step "
+                    f"{cfg['wire_rows_per_step_replica']} not below "
+                    f"{cfg['wire_rows_per_step_noreplica']}")
+        out[name] = cfg
+    out["note"] = (
+        "CPU-mesh epoch speed is reported honestly but is NOT the asserted "
+        "figure (no ICI) — the acceptance figures are the wire/true-byte "
+        "accounting: halo_bytes_true_total and wire rows/step strictly "
+        "lower with B>0 on the hp arm, and cache-aware km1 <= the "
+        "cache-blind partition's cache objective")
+    return out
+
+
 def bench_serve_qps(n: int, avg_deg: int, f: int, widths, graph: str = "ba"):
     """Sustained-QPS serving bench on the 8-virtual-device CPU mesh (the
     ``serve_qps_8dev`` block): synthetic open-loop traffic at a fixed
@@ -1246,6 +1420,12 @@ def main() -> None:
                    help="graph size for the GAT ragged A/B child (one "
                         "extra CPU-mesh run; smaller than --ragged-ab-n — "
                         "the attention tables make the arms heavier)")
+    p.add_argument("--skip-replica-ab", action="store_true",
+                   help="skip the hot-halo replication A/B child "
+                        "(replica_ab_8dev)")
+    p.add_argument("--replica-ab-n", type=int, default=30_000,
+                   help="graph size for the replica A/B child (one extra "
+                        "8-vdev process, four arms over two partitions)")
     p.add_argument("--skip-serve-qps", action="store_true",
                    help="skip the sustained-QPS serving bench "
                         "(serve_qps_8dev: open-loop traffic + a2a-vs-ragged "
@@ -1300,6 +1480,8 @@ def main() -> None:
     p.add_argument("--gat-ragged-ab-child", action="store_true",
                    help=argparse.SUPPRESS)
     p.add_argument("--ragged-stale-ab-child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--replica-ab-child", action="store_true",
                    help=argparse.SUPPRESS)
     p.add_argument("--serve-qps-child", action="store_true",
                    help=argparse.SUPPRESS)
@@ -1361,6 +1543,15 @@ def main() -> None:
             "value": None,      # the three-arm block is the payload
             **bench_ragged_stale_ab_child(ahat, feats, labels, widths,
                                           args.epochs, graph=args.graph),
+        }))
+        return
+
+    if args.replica_ab_child:
+        print(json.dumps({
+            "metric": "replica_ab",
+            "value": None,      # the per-partition blocks are the payload
+            **bench_replica_ab_child(ahat, feats, labels, widths,
+                                     args.epochs, graph=args.graph),
         }))
         return
 
@@ -1492,6 +1683,13 @@ def main() -> None:
             # a2a+stale vs ragged+exact vs ragged+stale
             vdev_metrics.update(bench_ragged_stale_ab(
                 args.ragged_stale_ab_n, args.avg_deg, args.f, widths,
+                max(2, args.epochs // 2), graph=args.vdev_graph))
+        if (args.model == "gcn" and args.halo_staleness == 0
+                and not args.skip_replica_ab):
+            # the hot-halo replication A/B (docs/replication.md): B>0 vs
+            # no-replica over balanced-random + cache-aware hp partitions
+            vdev_metrics.update(bench_replica_ab(
+                args.replica_ab_n, args.avg_deg, args.f, widths,
                 max(2, args.epochs // 2), graph=args.vdev_graph))
         if (args.model == "gcn" and args.halo_staleness == 0
                 and not args.skip_serve_qps):

@@ -1068,6 +1068,184 @@ int restart_budget(i64 n) {
   return n <= 2000 ? 12 : n <= 20000 ? 6 : n <= 1000000 ? 3 : 1;
 }
 
+// The k>1 body of sgcn_partition_hypergraph, extracted so the cache-aware
+// entry point (sgcn_partition_hypergraph_cache) reuses the identical
+// driver: restarts, RB-vs-direct selection, post-RB polish, and the
+// graph-seeded portfolio — byte-for-byte the behavior the plain ABI had.
+void hypergraph_driver(const Hypergraph& h, int k, double imbalance,
+                       int seed, std::vector<i32>& part) {
+  const i32 ncells = h.ncells;
+  const i32 nnets = h.nnets;
+  // restarts of the whole multilevel procedure (different coarsening and
+  // seeding draws); keep the best final km1 — the "more V-cycles /
+  // restarts" quality lever of the PaToH quality preset.  Small instances
+  // are cheap enough to search harder; huge ones get one pass.
+  const int restarts = restart_budget(ncells);
+  i64 best = -1;
+  std::vector<i32> cand;
+  PinCounts pc;
+  pc.k = k;
+  // high power-of-two k: recursive bisection (see
+  // partition_hypergraph_rb) replaces the direct k-way driver, whose
+  // O(deg·k) refinement measured slower AND worse at k >= 32;
+  // SGCN_HP_RB=1 forces RB wherever k is a power of two, =0 disables
+  const char* rb_env = std::getenv("SGCN_HP_RB");
+  const bool pow2 = (k & (k - 1)) == 0;
+  const bool use_rb = pow2 && rb_env != nullptr ? rb_env[0] == '1'
+                      : pow2 && k >= 32;
+  // Post-RB polish runs on a compact_nets'd COPY of the fine hypergraph
+  // (ADVICE r5): a column-net hypergraph of an undirected graph carries
+  // every net twice (mirror pairs), so identical-net merging halves the
+  // O(deg·k) gain scans of the direct k-way passes while the weighted
+  // km1 objective — and therefore every move decision's gain — stays
+  // exactly the original km1 (the ml path already refines compacted
+  // levels for the same reason).  Built once, reused across restarts;
+  // cells are untouched by compaction, so the part vector carries over.
+  Hypergraph hpol;
+  if (use_rb) {
+    hpol = h;
+    compact_nets(hpol);
+  }
+  for (int r = 0; r < restarts; ++r) {
+    if (use_rb)
+      partition_hypergraph_rb(h, k, imbalance, seed + 7919 * r, cand);
+    else
+      partition_hypergraph_ml(h, k, imbalance, seed + 7919 * r, cand);
+    double cap = (1.0 + imbalance) * (double)h.total_cwgt / k;
+    if (use_rb) {
+      // one direct k-way polish pass: RB never saw cross-side moves
+      rebalance_km1(hpol, k, cap, cand);
+      refine_km1(hpol, k, cap, cand, 2);
+    }
+    build_pincounts(h, cand, pc);
+    i64 score = km1_total(h, pc);
+    if (best < 0 || score < best) { best = score; part = cand; }
+  }
+  // Portfolio restart (small square instances): seed from the graph-model
+  // (edge-cut) partitioner's basin and refine under km1.  On small
+  // near-symmetric matrices the graph search sometimes finds a better
+  // basin than column-net coarsening; km1 refinement keeps the
+  // connectivity objective in charge, so the hypergraph partitioner never
+  // loses to the graph one on its own metric.  Gated by size so the
+  // products-scale run stays lean (hp wins outright there anyway,
+  // bench_artifacts/partition_comm_sweep.json).
+  if (ncells == nnets && ncells <= 200000) {
+    Graph g;
+    g.n = ncells;
+    std::vector<i64> keys;
+    keys.reserve(2 * h.cellnets.size());
+    for (i32 c = 0; c < ncells; ++c)
+      for (i64 e = h.cellptr[c]; e < h.cellptr[c + 1]; ++e) {
+        i64 j = h.cellnets[e];
+        if (j == c) continue;
+        keys.push_back((i64)c * nnets + j);
+        keys.push_back(j * (i64)nnets + c);
+      }
+    std::sort(keys.begin(), keys.end());
+    keys.erase(std::unique(keys.begin(), keys.end()), keys.end());
+    g.xadj.assign(ncells + 1, 0);
+    g.adj.resize(keys.size());
+    g.wgt.assign(keys.size(), 1.0f);
+    for (i64 key : keys) g.xadj[key / nnets + 1]++;
+    for (i32 v = 0; v < ncells; ++v) g.xadj[v + 1] += g.xadj[v];
+    for (size_t e = 0; e < keys.size(); ++e)
+      g.adj[e] = (i32)(keys[e] % nnets);
+    g.vwgt = h.cwgt;                 // balance on cell weights carries over
+    g.total_vwgt = h.total_cwgt;
+    double cap = (1.0 + imbalance) * (double)h.total_cwgt / k;
+    // same restart budget as the standalone graph partitioner, but each
+    // candidate is scored on km1 after connectivity refinement
+    for (int r = 0; r < restarts; ++r) {
+      partition_graph_ml(g, k, imbalance, seed + 31337 + 7919 * r, cand);
+      rebalance_km1(h, k, cap, cand);
+      refine_km1(h, k, cap, cand, 6);
+      build_pincounts(h, cand, pc);
+      i64 score = km1_total(h, pc);
+      if (score < best) { best = score; part = cand; }
+    }
+  }
+}
+
+// ------------------------------------------------- cache-aware km1 (replicas)
+// Hot-halo replication (CaPGNN-style): the training system promotes the
+// top-B boundary rows to persistent replicas on their consumer chips, so a
+// net whose source vertex is replicated STOPS costing km1 — its rows ship
+// once per refresh instead of once per exchange.  The cache-aware objective
+// of a partition is therefore km1 minus the contribution of the best-B
+// replica candidates, where candidates are ranked by (λ−1)·pins — the
+// hypergraph face of the plan-time λ·degree ranking (the owner part is a
+// pin here, so plan-λ = λ−1; pins ≈ consumer edges).  Deterministic
+// tie-break on net id, matching the plan side's vertex-id tie-break.
+struct CacheObjective {
+  i64 obj = 0;                 // km1 with the selected nets' cost removed
+  std::vector<i32> nets;       // the selected (replicated) nets
+};
+
+CacheObjective cache_objective(const Hypergraph& h,
+                               const std::vector<i32>& part, int k,
+                               i32 budget) {
+  PinCounts pc;
+  pc.k = k;
+  build_pincounts(h, part, pc);
+  CacheObjective out;
+  std::vector<std::pair<i64, i32>> scored;   // (-score, net): top-B order
+  std::vector<i64> contrib(h.nnets, 0);
+  for (i32 j = 0; j < h.nnets; ++j) {
+    const i32* r = pc.row(j);
+    int lambda = 0;
+    for (int p = 0; p < k; ++p) lambda += r[p] > 0;
+    if (lambda < 2) continue;
+    const i64 w = h.nwgt.empty() ? 1 : h.nwgt[j];
+    contrib[j] = w * (i64)(lambda - 1);
+    out.obj += contrib[j];
+    const i64 pins = h.netptr[j + 1] - h.netptr[j];
+    scored.push_back({-((i64)(lambda - 1) * pins), j});
+  }
+  const i32 b = (i32)std::min<i64>(budget, (i64)scored.size());
+  std::partial_sort(scored.begin(), scored.begin() + b, scored.end());
+  out.nets.reserve(b);
+  for (i32 i = 0; i < b; ++i) {
+    out.obj -= contrib[scored[i].second];
+    out.nets.push_back(scored[i].second);
+  }
+  return out;
+}
+
+// Co-optimize a partition with the replica budget: alternate (a) select the
+// current top-B replica nets, (b) refine under a weight vector with those
+// nets ZEROED (their pins move freely — the cut stops fighting the cache),
+// (c) re-score with a FRESH selection.  The incoming partition is the
+// first candidate, so the result's cache objective is <= the cache-blind
+// driver's by construction (monotone best-keep).
+i64 cache_cooptimize(const Hypergraph& h, int k, double imbalance,
+                     i32 budget, std::vector<i32>& part) {
+  const double cap = (1.0 + imbalance) * (double)h.total_cwgt / k;
+  Hypergraph hz = h;
+  if (hz.nwgt.empty()) hz.nwgt.assign(h.nnets, 1);
+  std::vector<i32> best = part;
+  i64 best_obj = cache_objective(h, part, k, budget).obj;
+  for (int round = 0; round < 3; ++round) {
+    CacheObjective sel = cache_objective(h, part, k, budget);
+    std::vector<i64> saved;
+    saved.reserve(sel.nets.size());
+    for (i32 j : sel.nets) {
+      saved.push_back(hz.nwgt[j]);
+      hz.nwgt[j] = 0;
+    }
+    rebalance_km1(hz, k, cap, part);
+    refine_km1(hz, k, cap, part, 3);
+    for (size_t i = 0; i < sel.nets.size(); ++i)
+      hz.nwgt[sel.nets[i]] = saved[i];
+    const i64 obj = cache_objective(h, part, k, budget).obj;
+    if (obj < best_obj) {
+      best_obj = obj;
+      best = part;
+    }
+  }
+  part = std::move(best);
+  return best_obj;
+}
+
 }  // namespace
 
 // ===================================================================== C ABI
@@ -1120,101 +1298,53 @@ int sgcn_partition_hypergraph(i32 ncells, i32 nnets, const i64* cellptr,
   Hypergraph h = from_cells(ncells, nnets, cellptr, cellnets, cwgt);
   std::vector<i32> part;
   if (k == 1) part.assign(ncells, 0);
-  else {
-    // restarts of the whole multilevel procedure (different coarsening and
-    // seeding draws); keep the best final km1 — the "more V-cycles /
-    // restarts" quality lever of the PaToH quality preset.  Small instances
-    // are cheap enough to search harder; huge ones get one pass.
-    const int restarts = restart_budget(ncells);
-    i64 best = -1;
-    std::vector<i32> cand;
-    PinCounts pc; pc.k = k;
-    // high power-of-two k: recursive bisection (see
-    // partition_hypergraph_rb) replaces the direct k-way driver, whose
-    // O(deg·k) refinement measured slower AND worse at k >= 32;
-    // SGCN_HP_RB=1 forces RB wherever k is a power of two, =0 disables
-    const char* rb_env = std::getenv("SGCN_HP_RB");
-    const bool pow2 = (k & (k - 1)) == 0;
-    const bool use_rb = pow2 && rb_env != nullptr ? rb_env[0] == '1'
-                        : pow2 && k >= 32;
-    // Post-RB polish runs on a compact_nets'd COPY of the fine hypergraph
-    // (ADVICE r5): a column-net hypergraph of an undirected graph carries
-    // every net twice (mirror pairs), so identical-net merging halves the
-    // O(deg·k) gain scans of the direct k-way passes while the weighted
-    // km1 objective — and therefore every move decision's gain — stays
-    // exactly the original km1 (the ml path already refines compacted
-    // levels for the same reason).  Built once, reused across restarts;
-    // cells are untouched by compaction, so the part vector carries over.
-    Hypergraph hpol;
-    if (use_rb) {
-      hpol = h;
-      compact_nets(hpol);
-    }
-    for (int r = 0; r < restarts; ++r) {
-      if (use_rb)
-        partition_hypergraph_rb(h, k, imbalance, seed + 7919 * r, cand);
-      else
-        partition_hypergraph_ml(h, k, imbalance, seed + 7919 * r, cand);
-      double cap = (1.0 + imbalance) * (double)h.total_cwgt / k;
-      if (use_rb) {
-        // one direct k-way polish pass: RB never saw cross-side moves
-        rebalance_km1(hpol, k, cap, cand);
-        refine_km1(hpol, k, cap, cand, 2);
-      }
-      build_pincounts(h, cand, pc);
-      i64 score = km1_total(h, pc);
-      if (best < 0 || score < best) { best = score; part = cand; }
-    }
-    // Portfolio restart (small square instances): seed from the graph-model
-    // (edge-cut) partitioner's basin and refine under km1.  On small
-    // near-symmetric matrices the graph search sometimes finds a better
-    // basin than column-net coarsening; km1 refinement keeps the
-    // connectivity objective in charge, so the hypergraph partitioner never
-    // loses to the graph one on its own metric.  Gated by size so the
-    // products-scale run stays lean (hp wins outright there anyway,
-    // bench_artifacts/partition_comm_sweep.json).
-    if (ncells == nnets && ncells <= 200000) {
-      Graph g;
-      g.n = ncells;
-      std::vector<i64> keys;
-      keys.reserve(2 * h.cellnets.size());
-      for (i32 c = 0; c < ncells; ++c)
-        for (i64 e = h.cellptr[c]; e < h.cellptr[c + 1]; ++e) {
-          i64 j = h.cellnets[e];
-          if (j == c) continue;
-          keys.push_back((i64)c * nnets + j);
-          keys.push_back(j * (i64)nnets + c);
-        }
-      std::sort(keys.begin(), keys.end());
-      keys.erase(std::unique(keys.begin(), keys.end()), keys.end());
-      g.xadj.assign(ncells + 1, 0);
-      g.adj.resize(keys.size());
-      g.wgt.assign(keys.size(), 1.0f);
-      for (i64 key : keys) g.xadj[key / nnets + 1]++;
-      for (i32 v = 0; v < ncells; ++v) g.xadj[v + 1] += g.xadj[v];
-      for (size_t e = 0; e < keys.size(); ++e)
-        g.adj[e] = (i32)(keys[e] % nnets);
-      g.vwgt = h.cwgt;                 // balance on cell weights carries over
-      g.total_vwgt = h.total_cwgt;
-      double cap = (1.0 + imbalance) * (double)h.total_cwgt / k;
-      // same restart budget as the standalone graph partitioner, but each
-      // candidate is scored on km1 after connectivity refinement
-      for (int r = 0; r < restarts; ++r) {
-        partition_graph_ml(g, k, imbalance, seed + 31337 + 7919 * r, cand);
-        rebalance_km1(h, k, cap, cand);
-        refine_km1(h, k, cap, cand, 6);
-        build_pincounts(h, cand, pc);
-        i64 score = km1_total(h, pc);
-        if (score < best) { best = score; part = cand; }
-      }
-    }
-  }
+  else hypergraph_driver(h, k, imbalance, seed, part);
   std::copy(part.begin(), part.end(), part_out);
   if (km1_out) {
     PinCounts pc; pc.k = k;
     build_pincounts(h, part, pc);
     *km1_out = km1_total(h, pc);
   }
+  return 0;
+}
+
+// Cache-aware flavor (hot-halo replication, docs/replication.md): same
+// driver, then co-optimize the cut with the replica budget — a net whose
+// source vertex is replicated costs 0, so refinement under the zeroed
+// weights moves pins the cache already pays for.  ``km1_cache_out`` gets
+// the cache-aware objective (km1 minus the selected top-B nets'
+// contribution, selection by (λ−1)·pins); by construction it is <= the
+// same objective evaluated on the cache-blind driver's partition at the
+// same seed/balance (the blind partition is the first candidate kept).
+// ``replica_budget <= 0`` degenerates to the plain driver with
+// km1_cache_out == km1_out.
+int sgcn_partition_hypergraph_cache(i32 ncells, i32 nnets,
+                                    const i64* cellptr, const i32* cellnets,
+                                    const i64* cwgt, int k, double imbalance,
+                                    int seed, i32 replica_budget,
+                                    i32* part_out, i64* km1_out,
+                                    i64* km1_cache_out) {
+  if (ncells <= 0 || k <= 0) return 1;
+  Hypergraph h = from_cells(ncells, nnets, cellptr, cellnets, cwgt);
+  std::vector<i32> part;
+  i64 cache = 0;
+  if (k == 1) part.assign(ncells, 0);
+  else {
+    hypergraph_driver(h, k, imbalance, seed, part);
+    if (replica_budget > 0)
+      cache = cache_cooptimize(h, k, imbalance, replica_budget, part);
+  }
+  std::copy(part.begin(), part.end(), part_out);
+  i64 km1 = 0;
+  {
+    PinCounts pc; pc.k = k;
+    build_pincounts(h, part, pc);
+    km1 = km1_total(h, pc);
+  }
+  if (k > 1 && replica_budget <= 0)
+    cache = km1;
+  if (km1_out) *km1_out = km1;
+  if (km1_cache_out) *km1_cache_out = cache;
   return 0;
 }
 
@@ -1382,7 +1512,7 @@ bool read_mtx(const std::string& path, Coo& out) {
 
 int main(int argc, char** argv) {
   std::string path, out_path;
-  int k = 2, seed = 1;
+  int k = 2, seed = 1, replica_budget = 0;
   double imbalance = 0.03;
   char mode = 'h';
   for (int i = 1; i < argc; ++i) {
@@ -1394,19 +1524,22 @@ int main(int argc, char** argv) {
     else if (a == "-o") out_path = next();
     else if (a == "-e") imbalance = std::stod(next());
     else if (a == "-s") seed = std::stoi(next());
+    else if (a == "-B") replica_budget = std::stoi(next());
     else { std::fprintf(stderr, "unknown flag %s\n", a.c_str()); return 2; }
   }
   if (path.empty() || k < 1 ||
-      (mode != 'g' && mode != 'h' && mode != 'r')) {
+      (mode != 'g' && mode != 'h' && mode != 'r') ||
+      (replica_budget > 0 && mode != 'h')) {
     std::fprintf(stderr,
-        "usage: sgcnpart -a graph.mtx -k K [-m g|h|r] [-o out] [-e imb] [-s seed]\n");
+        "usage: sgcnpart -a graph.mtx -k K [-m g|h|r] [-o out] [-e imb] "
+        "[-s seed] [-B replica_budget (mode h only: cache-aware km1)]\n");
     return 2;
   }
   Coo coo;
   if (!read_mtx(path, coo)) { std::fprintf(stderr, "cannot read %s\n", path.c_str()); return 1; }
   i32 n = coo.n;
   std::vector<i32> part(n, 0);
-  i64 metric = 0;
+  i64 metric = 0, metric_cache = 0;
   auto t0 = std::chrono::steady_clock::now();
   if (mode == 'r') {
     Rng rng((uint64_t)seed);
@@ -1443,9 +1576,14 @@ int main(int argc, char** argv) {
     std::vector<i64> pos(cellptr.begin(), cellptr.end() - 1);
     for (size_t e = 0; e < coo.row.size(); ++e)
       cellnets[pos[coo.row[e]]++] = coo.col[e];
-    sgcn_partition_hypergraph(n, n, cellptr.data(), cellnets.data(),
-                              cwgt.data(), k, imbalance, seed, part.data(),
-                              &metric);
+    if (replica_budget > 0)
+      sgcn_partition_hypergraph_cache(
+          n, n, cellptr.data(), cellnets.data(), cwgt.data(), k, imbalance,
+          seed, replica_budget, part.data(), &metric, &metric_cache);
+    else
+      sgcn_partition_hypergraph(n, n, cellptr.data(), cellnets.data(),
+                                cwgt.data(), k, imbalance, seed, part.data(),
+                                &metric);
   }
   auto t1 = std::chrono::steady_clock::now();
   double secs = std::chrono::duration<double>(t1 - t0).count();
@@ -1453,8 +1591,14 @@ int main(int argc, char** argv) {
   std::vector<i64> sizes(k, 0);
   for (i32 v = 0; v < n; ++v) sizes[part[v]]++;
   i64 maxs = *std::max_element(sizes.begin(), sizes.end());
-  std::printf("n=%d k=%d mode=%c metric=%lld max_part=%lld time_s=%.3f\n",
-              n, k, mode, (long long)metric, (long long)maxs, secs);
+  if (replica_budget > 0)
+    std::printf("n=%d k=%d mode=%c metric=%lld metric_cache=%lld B=%d "
+                "max_part=%lld time_s=%.3f\n",
+                n, k, mode, (long long)metric, (long long)metric_cache,
+                replica_budget, (long long)maxs, secs);
+  else
+    std::printf("n=%d k=%d mode=%c metric=%lld max_part=%lld time_s=%.3f\n",
+                n, k, mode, (long long)metric, (long long)maxs, secs);
   if (!out_path.empty()) {
     std::ofstream o(out_path);
     for (i32 v = 0; v < n; ++v) o << part[v] << "\n";

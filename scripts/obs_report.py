@@ -158,6 +158,25 @@ def render(path: str, max_steps: int = 12) -> str:
                              + f", relative {_fmt(rel[-1])} (last)"
                              + (f", quant-err {_stats(qe)}"
                                 if any(qe) else ""))
+        reps = [s["replica"] for s in steps if s.get("replica")]
+        if reps:
+            # hot-halo replication (--replica-budget, docs/replication.md):
+            # drift is measured AT each refresh (the drift the refresh
+            # erased) — between refreshes no fresh value exists to compare
+            lines.append("\nreplica gauges (hot-halo replication):")
+            last = reps[-1]
+            lines.append(
+                f"  replica rows: {last['replica_rows']}; refresh age: "
+                f"last {last['refresh_age']}, max "
+                + str(max(r["refresh_age"] for r in reps)))
+            syncs = [r for r in reps if r.get("sync_step")]
+            if syncs:
+                for layer in range(len(last["replica_drift_rms"])):
+                    dr = [r["replica_drift_rms"][layer] for r in syncs]
+                    rel = [r["replica_drift_rel"][layer] for r in syncs]
+                    lines.append(
+                        f"  layer {layer}: ‖replica−fresh‖ at refresh "
+                        + _stats(dr) + f", relative {_fmt(rel[-1])} (last)")
         hdr = (" step      loss  grad_norm    wall_s  exposed  age"
                "  drift_rms(last layer)")
         lines.append("\n" + hdr)

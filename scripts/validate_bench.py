@@ -57,6 +57,15 @@ What counts as a violation:
     partition (the forward-only carry-over of the training schedules'
     acceptance figure — never CPU-mesh latency; the ``note`` says so), or
     be ``null`` with a ``serve_qps_degraded`` marker;
+  * **replication accounting** (PR-10): a ``replica_ab_8dev`` block must
+    carry ``replica_budget > 0`` and per-partition configs whose shrunken
+    figures (replica true/wire rows, cumulative true bytes) never exceed
+    the full ones, with the hp config winning STRICTLY on
+    ``halo_bytes_true_total`` and wire rows/step (the CaPGNN before/after
+    metric — never CPU-mesh epoch speed; the ``note`` says so) and the
+    cache-aware km1 ≤ the cache-blind partition's cache objective
+    (``check_replica_ab``), or be ``null`` with a ``replica_ab_degraded``
+    marker;
   * **static-analysis report** (``bench_artifacts/analysis_report.json``,
     PR-9): a committed report must be a FULL-matrix run (``fast: false``)
     with ``ok: true`` and internally consistent — an ``ok`` flag
@@ -183,6 +192,8 @@ def check_bench_record(rec: dict) -> list[str]:
             errs += check_ragged_ab(parsed, prefix="gat_ragged_ab")
         if "ragged_stale_ab_8dev" in parsed:
             errs += check_ragged_stale_ab(parsed)
+        if "replica_ab_8dev" in parsed:
+            errs += check_replica_ab(parsed)
         if "serve_qps_8dev" in parsed:
             errs += check_serve_qps(parsed)
     return errs
@@ -389,9 +400,90 @@ def check_ragged_ab(parsed: dict, prefix: str = "ragged_ab") -> list[str]:
     return errs
 
 
+def check_replica_ab(parsed: dict) -> list[str]:
+    """The hot-halo-replication A/B block contract (PR-10,
+    docs/replication.md): a ``replica_ab_8dev`` block must carry B > 0,
+    per-partition configs with positive paired epoch times and equal step
+    counts implied by the cumulative gauges, shrunken figures never above
+    the full ones, and — STRICTLY, on the skewed hp partition — the
+    acceptance inequalities: ``halo_bytes_true_total`` and wire rows/step
+    lower with B>0 than the no-replica arm, plus the cache-aware km1 <=
+    the cache-blind partition's cache objective.  ``null`` needs a
+    ``replica_ab_degraded`` marker.  Never epoch speed: the virtual mesh
+    has no ICI."""
+    errs = []
+    block = parsed["replica_ab_8dev"]
+    if block is None:
+        if not isinstance(parsed.get("replica_ab_degraded"), str):
+            errs.append("replica_ab_8dev null without a replica_ab_degraded "
+                        "marker (graceful-degradation contract)")
+        return errs
+    if not isinstance(block, dict):
+        return [f"replica_ab_8dev is {type(block).__name__}, expected "
+                "dict or null"]
+    if not (_is_num(block.get("replica_budget"))
+            and block["replica_budget"] > 0):
+        errs.append(f"replica_ab_8dev: replica_budget="
+                    f"{block.get('replica_budget')!r} (need B > 0)")
+    configs = [c for c in ("random", "hp") if c in block]
+    if not configs:
+        return errs + ["replica_ab_8dev carries no random/hp partition "
+                       "config"]
+    for cfg in configs:
+        e = block[cfg]
+        if not isinstance(e, dict):
+            errs.append(f"replica_ab_8dev.{cfg} is not a dict")
+            continue
+        for key in ("epoch_s_noreplica", "epoch_s_replica"):
+            if not (_is_num(e.get(key)) and e[key] > 0):
+                errs.append(f"replica_ab_8dev.{cfg}.{key}={e.get(key)!r}")
+        if not (_is_num(e.get("replica_rows")) and e["replica_rows"] > 0):
+            errs.append(f"replica_ab_8dev.{cfg}.replica_rows="
+                        f"{e.get('replica_rows')!r} (B>0 must replicate "
+                        "at least one boundary row)")
+        for shrunk, full in (
+                ("true_rows_per_exchange_replica", "true_rows_per_exchange"),
+                ("wire_rows_per_exchange_replica", "wire_rows_per_exchange"),
+                ("halo_bytes_true_total_replica",
+                 "halo_bytes_true_total_noreplica"),
+                ("wire_rows_per_step_replica", "wire_rows_per_step_"
+                                               "noreplica")):
+            s, f = e.get(shrunk), e.get(full)
+            if not (_is_num(s) and _is_num(f) and s <= f):
+                errs.append(f"replica_ab_8dev.{cfg}: {shrunk}={s!r} "
+                            f"exceeds {full}={f!r} — deleting rows can "
+                            "never grow the exchange")
+    hp = block.get("hp")
+    if isinstance(hp, dict):
+        for shrunk, full in (
+                ("halo_bytes_true_total_replica",
+                 "halo_bytes_true_total_noreplica"),
+                ("wire_rows_per_step_replica",
+                 "wire_rows_per_step_noreplica")):
+            s, f = hp.get(shrunk), hp.get(full)
+            if _is_num(s) and _is_num(f) and not s < f:
+                errs.append(f"replica_ab_8dev.hp: {shrunk}={s!r} not "
+                            f"STRICTLY below {full}={f!r} on the skewed "
+                            "partition — the feature's acceptance figure")
+        kc, kb = (hp.get("km1_cache_aware"),
+                  hp.get("km1_cache_blind_partition"))
+        if not (_is_num(kc) and _is_num(kb) and kc <= kb):
+            errs.append(f"replica_ab_8dev.hp: km1_cache_aware={kc!r} not "
+                        f"<= the cache-blind partition's objective {kb!r} "
+                        "— the co-optimizer's acceptance inequality")
+    note = block.get("note")
+    if not (isinstance(note, str) and "wire" in note):
+        errs.append("replica_ab_8dev: missing the honest-measurement note "
+                    "naming the byte accounting as the asserted figure "
+                    "(CPU-mesh epoch speed is not the claim)")
+    return errs
+
+
 # the supported-matrix floor a committed analysis report may not shrink
-# below (27 mode entries at PR-9 HEAD; the matrix only grows)
-ANALYSIS_MIN_MODES = 27
+# below (31 mode entries at PR-10 HEAD: PR-9's 27 + the four hot-halo
+# replication modes of the {a2a,ragged} × {f32,bf16} B>0 matrix entry;
+# the matrix only grows)
+ANALYSIS_MIN_MODES = 31
 
 
 def check_analysis_report(rec: dict) -> list[str]:

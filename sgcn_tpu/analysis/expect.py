@@ -80,12 +80,16 @@ def _gcn_layer_plan(fin: int, widths) -> tuple[list, list]:
     return fs, pf
 
 
-def _exchange_ops(plan, schedule: str, lane: int | None, dtype: str) -> list:
+def _exchange_ops(plan, schedule: str, lane: int | None, dtype: str,
+                  replica: bool = False) -> list:
     """The collective dispatches of ONE halo exchange shipping ``lane``
-    trailing lanes (``None`` = no lane axis, e.g. the GAT split scalar)."""
+    trailing lanes (``None`` = no lane axis, e.g. the GAT split scalar).
+    ``replica=True``: the SHRUNKEN no-replica exchange of a
+    ``--replica-budget`` step (``CommPlan.wire_buffer_shapes(replica=True)``
+    — the ``nrep_s`` pad / live rounds of ``nrep_rr_sizes``)."""
     kind = "all_to_all" if schedule == "a2a" else "collective_permute"
     out = []
-    for shape in plan.wire_buffer_shapes(schedule):
+    for shape in plan.wire_buffer_shapes(schedule, replica=replica):
         full = shape if lane is None else shape + (lane,)
         out.append((kind, full, dtype))
     return out
@@ -120,20 +124,28 @@ def train_expectation(trainer, mode, fresh: bool = False) -> Expectation:
     if mode.model == "gcn":
         fs, pf = _gcn_layer_plan(trainer.fin, trainer.widths)
         fdt, gdt = _wire_dtypes_gcn(mode, fresh)
+        # replica REPLICA step (fresh=False): both directions ship the
+        # SHRUNKEN nrep layout; the refresh (fresh=True) step ships the
+        # full exact exchange
+        rep_wire = bool(mode.replica) and not fresh
         for i in range(L):                       # forward: every layer
-            exp.exchanges += _exchange_ops(plan, mode.schedule, fs[i], fdt)
-        if mode.staleness:
-            # backward: the fresh gradient ring/a2a is EMITTED for every
-            # layer — it is next step's carry, so layer 0's survives even
-            # though dL/dh0 is dead
+            exp.exchanges += _exchange_ops(plan, mode.schedule, fs[i], fdt,
+                                           replica=rep_wire)
+        if mode.staleness or (mode.replica and fresh):
+            # backward: the fresh gradient exchange is EMITTED for every
+            # layer — it is next step's carry (stale mode) / the refreshed
+            # gradient-replica table (replica refresh step), so layer 0's
+            # survives even though dL/dh0 is dead
             bwd_layers = range(L)
         else:
-            # exact mode: layer 0's backward exchange exists only under
+            # exact mode (and the replica step, whose grep cotangent is a
+            # pass-through): layer 0's backward exchange exists only under
             # project-first (dL/d(h·W) feeds dW); aggregate-first layer 0
             # only needs dL/dagg-out, and its dL/dh0 path is dead code
             bwd_layers = [i for i in range(L) if i > 0 or pf[0]]
         for i in bwd_layers:
-            exp.exchanges += _exchange_ops(plan, mode.schedule, fs[i], gdt)
+            exp.exchanges += _exchange_ops(plan, mode.schedule, fs[i], gdt,
+                                           replica=rep_wire)
     else:
         from ..models.gat import gat_table_form
         for i in range(L):
@@ -166,6 +178,8 @@ def train_expectation(trainer, mode, fresh: bool = False) -> Expectation:
     groups = [("donate", trainer.params), ("donate", trainer.opt_state)]
     if mode.staleness:
         groups.append(("donate", trainer.halo_carry))
+    if mode.replica:
+        groups.append(("donate", trainer.replica_carry))
     groups += [("keep", trainer.pa)]
     exp.args = _classify_args(groups)
     k, b = plan.k, plan.b

@@ -54,6 +54,11 @@ AUDIT_K = 8
 AUDIT_N = 96
 AUDIT_FIN = 8
 AUDIT_WIDTHS = (8, 4)
+# replica-mode audits run at this fixed budget: large enough that the
+# shrunken nrep pads differ from the full ones on the ER fixture (the
+# wire-shape rule sees real shrinkage), small enough that every chip
+# keeps non-replica traffic (all rounds stay live)
+AUDIT_REPLICA_B = 12
 
 
 @lru_cache(maxsize=None)
@@ -338,7 +343,10 @@ def lower_mode(mode: Mode, plan=None) -> list[tuple]:
             kw.update(halo_dtype=mode.halo_dtype,
                       halo_staleness=mode.staleness,
                       halo_delta=mode.delta,
-                      sync_every=2 if mode.staleness else 0)
+                      sync_every=2 if (mode.staleness or mode.replica)
+                      else 0,
+                      replica_budget=AUDIT_REPLICA_B if mode.replica
+                      else 0)
         else:
             kw.update(compute_dtype=mode.compute_dtype)
         with _gat_form_env(mode.gat_form):
@@ -350,6 +358,17 @@ def lower_mode(mode: Mode, plan=None) -> list[tuple]:
                     ("stale", tr.lower_step(kind="stale").as_text(),
                      expect.train_expectation(tr, mode, fresh=False)),
                     ("sync", tr.lower_step(kind="sync").as_text(),
+                     expect.train_expectation(tr, mode, fresh=True)),
+                ]
+            if mode.replica:
+                # both programs of a replica mode are audited: the replica
+                # step must ship the SHRUNKEN wire shapes, the refresh step
+                # the full exact exchange (with every backward exchange
+                # kept alive by the gradient-replica refresh)
+                return [
+                    ("rep", tr.lower_step(kind="rep").as_text(),
+                     expect.train_expectation(tr, mode, fresh=False)),
+                    ("sync", tr.lower_step(kind="rep_sync").as_text(),
                      expect.train_expectation(tr, mode, fresh=True)),
                 ]
             return [("step", tr.lower_step().as_text(),

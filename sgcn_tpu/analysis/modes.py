@@ -33,6 +33,7 @@ MODE_FLAGS = {
     "--halo-staleness": "staleness",
     "--halo-dtype": "halo_dtype",
     "--halo-delta": "delta",
+    "--replica-budget": "replica",
 }
 
 # knobs that look mode-like but are deliberately NOT matrix axes — named
@@ -56,6 +57,9 @@ class Mode:
     halo_dtype: str | None = None  # None (f32 wire) | 'bfloat16'
     delta: bool = False            # halo-delta cache (stale GCN only)
     gat_form: str | None = None    # 'fused' | 'split' | 'packed' (GAT only)
+    replica: bool = False          # hot-halo replication, B > 0 (GCN only;
+    #                                the axis is binary — the audit runs at
+    #                                a fixed small B, hlo_audit.AUDIT_REPLICA_B)
 
     @property
     def mode_id(self) -> str:
@@ -67,6 +71,8 @@ class Mode:
             parts.append("bf16" if self.halo_dtype == "bfloat16" else "f32")
             if self.delta:
                 parts.append("delta")
+            if self.replica:
+                parts.append("rep")
         return "/".join(parts)
 
     @property
@@ -98,6 +104,9 @@ def is_supported(mode: Mode) -> tuple[bool, str]:
                            "table forms (compute_dtype)")
         if m.delta:
             return False, "halo_delta requires halo_staleness=1 (GCN only)"
+        if m.replica:
+            return False, ("the GAT exchange ships per-layer attention "
+                           "tables whose replication is not supported")
         if m.gat_form not in GAT_FORMS:
             return False, f"unknown GAT table form {m.gat_form!r}"
     else:
@@ -105,10 +114,18 @@ def is_supported(mode: Mode) -> tuple[bool, str]:
             return False, "gat_form is a GAT axis"
     if m.delta and not m.staleness:
         return False, "halo_delta accumulates into the stale halo carry"
-    if m.workload in ("serve", "minibatch") and (m.staleness or m.delta):
-        return False, ("staleness/delta are full-batch TRAINING levers; "
-                       "serving always runs the exact forward and the "
-                       "mini-batch trainer re-plans per batch")
+    if m.replica and m.staleness:
+        return False, ("replica_budget composed with halo_staleness=1 is "
+                       "deferred: the two carry families would share the "
+                       "sync schedule but disagree on what a non-sync "
+                       "exchange ships")
+    if m.workload in ("serve", "minibatch") and (m.staleness or m.delta
+                                                 or m.replica):
+        return False, ("staleness/delta/replication are full-batch "
+                       "TRAINING levers; serving always runs the exact "
+                       "forward and the mini-batch trainer re-plans per "
+                       "batch (replica carries have no stable identity "
+                       "across batch plans)")
     if m.workload == "minibatch" and m.model == "gat":
         # supported by the trainer, but the audit covers the mini-batch
         # envelope once (GCN) — the GAT program is the same per-layer
@@ -129,10 +146,13 @@ def supported_modes() -> list[Mode]:
     bug in ``is_supported``, not in a hand-maintained list.
     """
     modes: list[Mode] = []
-    # train / GCN: schedule × staleness × halo-dtype × delta
-    for sched, stale, hd, delta in itertools.product(
-            ("a2a", "ragged"), (0, 1), (None, "bfloat16"), (False, True)):
-        modes.append(Mode("train", "gcn", sched, stale, hd, delta))
+    # train / GCN: schedule × staleness × halo-dtype × delta × replica
+    # (is_supported filters the deferred stale × replica composition)
+    for sched, stale, hd, delta, rep in itertools.product(
+            ("a2a", "ragged"), (0, 1), (None, "bfloat16"), (False, True),
+            (False, True)):
+        modes.append(Mode("train", "gcn", sched, stale, hd, delta,
+                          replica=rep))
     # train / GAT: schedule × table form
     for sched, form in itertools.product(("a2a", "ragged"), GAT_FORMS):
         modes.append(Mode("train", "gat", sched, gat_form=form))
@@ -160,14 +180,16 @@ def fast_modes() -> list[Mode]:
 
 def train_matrix_verdicts() -> dict:
     """The ``docs/comm_schedule.md`` composition-matrix rows (schedule ×
-    staleness × delta × model) as enumerator verdicts — the machine-readable
-    face of that table.  ``tests/test_analysis.py`` pins the two against
-    each other."""
+    staleness × delta × replicas × model) as enumerator verdicts — the
+    machine-readable face of that table.  ``tests/test_analysis.py`` pins
+    the two against each other."""
     out = {}
-    for sched, stale, delta, model in itertools.product(
-            ("a2a", "ragged"), (0, 1), (False, True), ("gcn", "gat")):
+    for sched, stale, delta, rep, model in itertools.product(
+            ("a2a", "ragged"), (0, 1), (False, True), (False, True),
+            ("gcn", "gat")):
         mode = Mode("train", model, sched, stale, None, delta,
-                    gat_form="fused" if model == "gat" else None)
+                    gat_form="fused" if model == "gat" else None,
+                    replica=rep)
         ok, reason = is_supported(mode)
-        out[(sched, stale, delta, model)] = (ok, reason)
+        out[(sched, stale, delta, rep, model)] = (ok, reason)
     return out

@@ -40,6 +40,9 @@ CONSUMER_TUPLE_SOURCES = {
         "sgcn_tpu.models.gcn:GCN_PLAN_FIELDS_RAGGED",
     "STALE_PLAN_FIELDS_RAGGED":
         "sgcn_tpu.parallel.plan:STALE_PLAN_FIELDS_RAGGED",
+    "REPLICA_PLAN_FIELDS": "sgcn_tpu.parallel.plan:REPLICA_PLAN_FIELDS",
+    "REPLICA_PLAN_FIELDS_RAGGED":
+        "sgcn_tpu.parallel.plan:REPLICA_PLAN_FIELDS_RAGGED",
     "SERVE_ROUTER_FIELDS": "sgcn_tpu.serve.router:SERVE_ROUTER_FIELDS",
 }
 

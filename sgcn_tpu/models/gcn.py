@@ -22,7 +22,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.pspmm import (pspmm_ell_sym, pspmm_overlap, pspmm_ragged_sym,
-                         pspmm_stale, pspmm_stale_ragged)
+                         pspmm_replica, pspmm_replica_ragged, pspmm_stale,
+                         pspmm_stale_ragged)
 from ..parallel.mesh import AXIS
 from .activations import get_activation
 
@@ -286,6 +287,90 @@ def gcn_forward_local_stale(
     if gauges:
         return h, new_halos, new_bases, qerrs
     return h, new_halos, new_bases
+
+
+def gcn_forward_local_replica(
+    params,
+    h,                      # (B, f_in) local feature rows
+    pa,                     # plan arrays dict (REPLICA_PLAN_FIELDS /
+    #                         REPLICA_PLAN_FIELDS_RAGGED)
+    reps,                   # per-layer replica carries: (RP, f_ℓ)
+    greps,                  # per-layer gradient-replica carries (same shapes)
+    activation: str = "relu",
+    final_activation: str = "none",
+    ell_buckets: tuple | None = None,
+    halo_dtype: str | None = None,  # static: wire-only exchange dtype
+    fresh: bool = False,            # static: refresh (sync) step — the full
+    #                                 exact exchange, replicas re-read fresh
+    comm_schedule: str = "a2a",     # static: 'a2a' (pspmm_replica) or
+    #                                 'ragged' (pspmm_replica_ragged)
+    rr_sizes: tuple | None = None,       # static plan.rr_sizes (ragged)
+    rr_edge_sizes: tuple | None = None,  # static plan.rr_edge_sizes (ragged)
+    nrep_rr_sizes: tuple | None = None,  # static plan.nrep_rr_sizes (ragged)
+    halo_r: int | None = None,           # static plan.r (ragged halo table)
+    axis_name: str = AXIS,
+):
+    """Per-chip forward under hot-halo replication (``--replica-budget``).
+
+    Same layer math and project-first scheduling as ``gcn_forward_local``,
+    but every aggregation goes through a replica-aware op: the plan's top-B
+    boundary rows never ride the per-layer wire — their halo slots fill
+    from ``reps[ℓ]``/``greps[ℓ]``, refreshed only on ``fresh`` (sync)
+    steps, where the program is EXACTLY the exact path plus the replica
+    gathers (the f32 bit-identity contract of ``--sync-every 1``).
+    Returns ``(out, new_reps)``; the gradient-replica carries come back as
+    the ``greps`` cotangents of ``jax.value_and_grad`` (see
+    ``pspmm_replica``).  Symmetric-Â plans only — the trainer gates on
+    ``plan.symmetric``.
+    """
+    if ell_buckets is None:
+        raise ValueError(
+            "replica GCN forward needs the plan's static ell_buckets")
+    if comm_schedule not in ("a2a", "ragged"):
+        raise ValueError(f"unknown comm_schedule {comm_schedule!r} "
+                         "(the trainer resolves 'auto' before the forward)")
+    if comm_schedule == "ragged" and (rr_sizes is None
+                                      or rr_edge_sizes is None
+                                      or nrep_rr_sizes is None
+                                      or halo_r is None):
+        raise ValueError(
+            "ragged replica forward needs the plan's static rr_sizes + "
+            "rr_edge_sizes + nrep_rr_sizes + halo table height "
+            "(CommPlan.ensure_ragged + ensure_replicas)")
+    act = get_activation(activation)
+    fact = get_activation(final_activation)
+    nl = len(params)
+    new_reps = []
+    for i, w in enumerate(params):
+        # identical scheduling rule to gcn_forward_local: the carry widths
+        # (plan.replica_carry_shapes → exchange_widths) encode the same rule
+        project_first = (w.shape[1] < h.shape[1]
+                         and h.shape[1] >= PROJECT_FIRST_MIN_FIN)
+        x = (h @ w) if project_first else h
+        if comm_schedule == "ragged":
+            z, rn = pspmm_replica_ragged(
+                x, reps[i], greps[i], pa["rsend_idx"],
+                pa["nrep_rsend_idx"], pa["nrep_rhalo_dst"], pa["rep_slots"],
+                pa["rep_ring_pos"],
+                pa["ell_idx"], pa["ell_w"],
+                pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
+                pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
+                pa["redge_dst"], pa["redge_src"], pa["redge_w"],
+                ell_buckets, rr_sizes, rr_edge_sizes, nrep_rr_sizes,
+                halo_r, axis_name, halo_dtype, fresh)
+        else:
+            z, rn = pspmm_replica(
+                x, reps[i], greps[i], pa["send_idx"], pa["halo_src"],
+                pa["nrep_send_idx"], pa["nrep_halo_src"], pa["rep_slots"],
+                pa["ell_idx"], pa["ell_w"],
+                pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
+                pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
+                ell_buckets, axis_name, halo_dtype, fresh)
+        if not project_first:
+            z = z @ w
+        new_reps.append(rn)
+        h = fact(z) if i == nl - 1 else act(z)
+    return h, new_reps
 
 
 def masked_softmax_xent_local(logits, labels, valid, axis_name: str = AXIS):

@@ -123,7 +123,8 @@ class StepCostModel:
 def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
               wire_itemsize=None,
               comm_schedule: str = "a2a",
-              model: str = "gcn") -> StepCostModel:
+              model: str = "gcn",
+              replica: bool = False) -> StepCostModel:
     """Build the cost model for one (plan, layer-stack) pair.
 
     ``compute_dtype='bfloat16'`` halves the gather/wire itemsize (the
@@ -150,7 +151,15 @@ def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
     exchange's send/halo gathers).  Wire accounting is therefore the same
     figure CommStats' lane-weighted gauges report — the parity the
     reconciliation smokes pin (``wire_itemsize`` is ignored for GAT; its
-    wire levers are the table forms themselves)."""
+    wire levers are the table forms themselves).
+
+    ``replica=True`` prices the hot-halo-replication REPLICA step
+    (``--replica-budget``, docs/replication.md): the exchange ships the
+    shrunken ``nrep_*`` layout, so BOTH the true volume (replicated rows
+    genuinely leave the exchange — ``plan.replica_send_volume``) and the
+    wire rows (``plan.wire_rows_per_exchange(..., replica=True)``)
+    shrink; refresh steps use the default full model.  GCN only (the
+    trainer gates replication to it)."""
     if model == "gat":
         from ..models.gat import gat_exchange_lane_widths
         plan.ensure_cell()
@@ -173,8 +182,15 @@ def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
         nnz = int(plan.nnz.max()) if plan.nnz.size else 0
     dims = list(zip([fin] + list(widths)[:-1], widths))
     b = plan.b
-    send_rows = int(plan.predicted_send_volume.sum())
-    wire_rows = int(plan.wire_rows_per_exchange(comm_schedule))
+    if replica:
+        if model == "gat":
+            raise ValueError("replica pricing is a GCN-trainer lever")
+        send_rows = int(plan.replica_send_volume.sum())
+        wire_rows = int(plan.wire_rows_per_exchange(comm_schedule,
+                                                    replica=True))
+    else:
+        send_rows = int(plan.predicted_send_volume.sum())
+        wire_rows = int(plan.wire_rows_per_exchange(comm_schedule))
 
     # per-layer bytes are PER EXCHANGE at the mean of the two directions'
     # itemsizes, so 2L × per-layer == the per-step totals exactly (the

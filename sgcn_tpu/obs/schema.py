@@ -90,6 +90,7 @@ _OPTIONAL = {
         "phases": dict,       # PhaseTimer.report() snapshot
         "roofline": dict,     # attribution.roofline_fields output
         "drift": dict,        # stale-halo drift gauges (see below)
+        "replica": dict,      # hot-halo replication gauges (see below)
         "epoch": _NUM,
         "batch": _NUM,        # mini-batch trainer: batch index within epoch
         # v2: measured-vs-analytic reconciliation block (obs/tracing.py):
@@ -158,6 +159,17 @@ DRIFT_KEYS = ("staleness_age", "sync_step", "halo_drift_rms",
 # staleness-age vector — one entry per ring round, the age of the buffer
 # this step CONSUMED (0 = received this step, N = carried N steps,
 # null = empty round, ships nothing).
+
+# replica-gauge fields (--replica-budget mode only): the AUTHORITATIVE
+# field list — ``validate_event`` requires every one of these in a step
+# event's ``replica`` block (``FullBatchTrainer._replica_fields``):
+# ``refresh_age`` = steps since the replica tables were last refreshed,
+# ``replica_drift_rms``/``_rel`` = per-layer ‖replica − fresh‖ measured AT
+# each refresh (the drift the refresh erased; identically zero between
+# refreshes, where no fresh value exists to compare against),
+# ``replica_rows`` = the plan's replicated row count.
+REPLICA_KEYS = ("refresh_age", "sync_step", "replica_rows",
+                "replica_drift_rms", "replica_drift_rel")
 
 _MANIFEST_REQUIRED = {"v": _NUM, "ts": _NUM, "run_kind": _STR, "config": dict}
 _MANIFEST_OPTIONAL = {
@@ -346,6 +358,26 @@ def validate_event(ev: dict) -> None:
                 raise ValueError(
                     f"drift round_age must be a list of null / non-negative "
                     f"ages (one per ring round), got {ra!r}")
+    if kind == "step" and ev.get("replica") is not None:
+        rb = ev["replica"]
+        missing = [k for k in REPLICA_KEYS if k not in rb]
+        if missing:
+            raise ValueError(
+                f"step event replica block missing {missing} "
+                f"(must carry every REPLICA_KEYS field)")
+        for f in ("refresh_age", "replica_rows"):
+            if not (isinstance(rb[f], _NUM) and not isinstance(rb[f], bool)
+                    and math.isfinite(rb[f]) and rb[f] >= 0):
+                raise ValueError(
+                    f"replica block: non-finite/negative {f}={rb[f]!r}")
+        for f in ("replica_drift_rms", "replica_drift_rel"):
+            v = rb[f]
+            if not isinstance(v, list) or any(
+                    not (isinstance(x, _NUM) and not isinstance(x, bool)
+                         and math.isfinite(x) and x >= 0) for x in v):
+                raise ValueError(
+                    f"replica block: {f} must be a list of finite "
+                    f"non-negative per-layer norms, got {v!r}")
 
 
 def validate_manifest(m: dict) -> None:

@@ -538,6 +538,283 @@ def _pspmm_ragged_sym_bwd(buckets, rr_sizes, rr_edge_sizes, axis_name,
 pspmm_ragged_sym.defvjp(_pspmm_ragged_sym_fwd, _pspmm_ragged_sym_bwd)
 
 
+# ------------------------------------------------------------------ replicas
+# Hot-halo replication (CaPGNN-style, arXiv:2508.13716): the plan's top-B
+# boundary rows by λ·degree live as PERSISTENT REPLICAS on their consumer
+# chips (``CommPlan.ensure_replicas``).  A replica step exchanges only the
+# shrunken no-replica buckets (``nrep_*`` — replicated rows leave the wire
+# entirely, forward AND backward) and fills the replica halo slots from a
+# carried per-layer replica table; a refresh (sync) step runs EXACTLY the
+# full exact exchange — same collectives, same fold order, f32-bit-identical
+# math — and re-reads the replica rows out of the fresh halo as the next
+# carry.  Gradient replicas mirror the structure through the same cotangent
+# channel as ``pspmm_stale``'s ``ghalo_in``: differentiate the caller w.r.t.
+# its ``greps`` carry and the "grad" that comes back IS next refresh's
+# gradient-replica table (fresh on sync steps, the pass-through carry
+# otherwise).  Unlike the stale mode, every exchange here is SYNCHRONOUS
+# (same-step consumer): replication shrinks wire bytes, not exposure.
+# Symmetric-Â only, like every custom-VJP op in this file.
+
+
+def _replica_halo(x, rep, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+                  rep_slots, axis_name, halo_dtype, fresh):
+    """One replica-aware halo exchange; returns ``(halo, rep_next)``.
+
+    ``fresh``: the FULL exchange (bit-identical to ``halo_exchange``) plus
+    the replica refresh ``halo[rep_slots]`` — PADDING carry slots
+    (``rep_slots`` holds ``r`` there, out of range) are zeroed, not left
+    with the clip-gather's junk row: they are never consumed (the ``.set``
+    drops them), but the drift gauges sum over the whole carry, and
+    step-varying junk in pad slots would masquerade as replica drift.
+    Otherwise: the shrunken exchange, with replica slots overwritten from
+    the carry and the carry passed through unchanged."""
+    if fresh:
+        halo = halo_exchange(x, send_idx, halo_src, axis_name, halo_dtype)
+        valid = (rep_slots < halo.shape[0])[:, None].astype(halo.dtype)
+        return halo, jnp.take(halo, rep_slots, axis=0, mode="clip") * valid
+    halo = halo_exchange(x, nrep_send_idx, nrep_halo_src, axis_name,
+                         halo_dtype)
+    halo = halo.at[rep_slots].set(rep.astype(halo.dtype), mode="drop")
+    return halo, rep
+
+
+def _pspmm_replica_once(x, rep_in, send_idx, halo_src, nrep_send_idx,
+                        nrep_halo_src, rep_slots, ell_idx, ell_w,
+                        ltail_dst, ltail_src, ltail_w,
+                        hedge_dst, hedge_src, hedge_w,
+                        buckets, axis_name, halo_dtype, fresh):
+    halo, rep_next = _replica_halo(
+        x, rep_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+        rep_slots, axis_name, halo_dtype, fresh)
+    # same dependence structure as the exact path: the local ELL pass has
+    # no data dependence on the exchange (overlap), the halo fold waits
+    local = spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, x,
+                     buckets)
+    remote = spmm_local(hedge_dst, hedge_src, hedge_w, halo, x.shape[0])
+    return local + remote, rep_next
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(16, 17, 18, 19))
+def pspmm_replica(x, rep_in, grep_in, send_idx, halo_src,
+                  nrep_send_idx, nrep_halo_src, rep_slots,
+                  ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+                  hedge_dst, hedge_src, hedge_w, buckets,
+                  axis_name=AXIS, halo_dtype=None, fresh=False):
+    """``PSpMM`` with persistent hot-halo replicas on the dense a2a.
+
+    Replica (``fresh=False``) step: the a2a ships the SHRUNKEN
+    ``(k, S')`` buckets (replicated rows off the wire, both directions),
+    the halo table's replica slots fill from ``rep_in``/``grep_in``, and
+    both carries pass through unchanged.  Refresh (``fresh=True``) step:
+    the full exact exchange — the program is the exact path plus the
+    replica-row gathers, so a ``--sync-every 1`` trajectory is
+    f32-bit-identical to the no-replica path — and both carries come back
+    fresh (features via ``rep_next``, gradients via the ``grep_in``
+    cotangent).  Returns ``(out, rep_next)``; the carry output's cotangent
+    is structurally zero (it crosses the step boundary).
+    """
+    return _pspmm_replica_once(
+        x, rep_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+        rep_slots, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+        hedge_dst, hedge_src, hedge_w, buckets, axis_name, halo_dtype,
+        fresh)
+
+
+def _pspmm_replica_fwd(x, rep_in, grep_in, send_idx, halo_src,
+                       nrep_send_idx, nrep_halo_src, rep_slots,
+                       ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+                       hedge_dst, hedge_src, hedge_w, buckets,
+                       axis_name, halo_dtype, fresh):
+    out = _pspmm_replica_once(
+        x, rep_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+        rep_slots, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+        hedge_dst, hedge_src, hedge_w, buckets, axis_name, halo_dtype,
+        fresh)
+    res = (grep_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+           rep_slots, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+           hedge_dst, hedge_src, hedge_w)
+    return out, res
+
+
+def _pspmm_replica_bwd(buckets, axis_name, halo_dtype, fresh, res, cts):
+    (grep_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src, rep_slots,
+     ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+     hedge_dst, hedge_src, hedge_w) = res
+    g, _ = cts               # carry cotangent is structurally zero
+    # gradient exchange mirrors the forward exactly: shrunken buckets +
+    # gradient-replica carry on replica steps, the full exchange (whose
+    # replica rows refresh the carry through this cotangent) on syncs
+    ghalo, grep_next = _replica_halo(
+        g, grep_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+        rep_slots, axis_name, halo_dtype, fresh)
+    gx = (spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, g, buckets)
+          + spmm_local(hedge_dst, hedge_src, hedge_w, ghalo, g.shape[0]))
+    return (gx, None, grep_next, *[None] * 13)
+
+
+pspmm_replica.defvjp(_pspmm_replica_fwd, _pspmm_replica_bwd)
+
+
+def _replica_ring_halo(x, rep, rsend_idx, nrep_rsend_idx, nrep_rhalo_dst,
+                       rep_slots, rep_ring_pos, rr_sizes, nrep_rr_sizes,
+                       halo_r, axis_name, halo_dtype, fresh):
+    """One replica-aware ragged-ring exchange.
+
+    ``fresh``: ship the FULL per-round ring and return the round-major
+    receive concat (the PR-6 carry layout — folding it through ``redge_*``
+    is f32-bit-identical to the exact ragged path) plus the replica rows
+    gathered at ``rep_ring_pos``.  Otherwise: ship the SHRUNKEN ring
+    (``nrep_rr_sizes`` — live rounds per ``ragged_live_rounds``, the shared
+    elision rule), scatter receives into the halo table, overwrite replica
+    slots from the carry, and pass the carry through.  Returns
+    ``(ring_concat_or_halo_table, rep_next)`` — the caller folds the first
+    element per mode (``redge_*`` ring fold when fresh, dense ``hedge_*``
+    fold otherwise)."""
+    f = x.shape[-1]
+    if fresh:
+        segs = []
+        live = ragged_live_rounds(rr_sizes)
+        off = 0
+        for d, sd in enumerate(rr_sizes, start=1):
+            if d not in live:
+                off += sd    # keep slice bookkeeping right under ANY rule
+                continue
+            buf = jnp.take(x, rsend_idx[off: off + sd], axis=0)
+            if halo_dtype is not None:
+                buf = buf.astype(halo_dtype)
+            segs.append(ppermute_or_identity(buf, axis_name, d)
+                        .astype(x.dtype))
+            off += sd
+        ring = (jnp.zeros((1, f), x.dtype) if not segs
+                else (segs[0] if len(segs) == 1 else jnp.concatenate(segs)))
+        # zero padding carry slots (rep_slots == r there) — same drift-gauge
+        # hygiene as the a2a refresh: pad rows are never consumed, but junk
+        # in them would pollute Σ(rep_next − rep_in)²
+        valid = (rep_slots < halo_r)[:, None].astype(x.dtype)
+        return ring, jnp.take(ring, rep_ring_pos, axis=0, mode="clip") * valid
+    halo = jnp.zeros((halo_r, f), x.dtype)
+    live = ragged_live_rounds(nrep_rr_sizes)
+    off = 0
+    for d, sd in enumerate(nrep_rr_sizes, start=1):
+        if d not in live:
+            off += sd        # keep slice bookkeeping right under ANY rule
+            continue
+        buf = jnp.take(x, nrep_rsend_idx[off: off + sd], axis=0)
+        if halo_dtype is not None:
+            buf = buf.astype(halo_dtype)
+        recv = ppermute_or_identity(buf, axis_name, d).astype(x.dtype)
+        halo = halo.at[nrep_rhalo_dst[off: off + sd]].set(recv, mode="drop")
+        off += sd
+    halo = halo.at[rep_slots].set(rep.astype(x.dtype), mode="drop")
+    return halo, rep
+
+
+def _pspmm_replica_ragged_once(x, rep_in, rsend_idx, nrep_rsend_idx,
+                               nrep_rhalo_dst, rep_slots, rep_ring_pos,
+                               ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+                               hedge_dst, hedge_src, hedge_w,
+                               redge_dst, redge_src, redge_w,
+                               buckets, rr_sizes, rr_edge_sizes,
+                               nrep_rr_sizes, halo_r, axis_name, halo_dtype,
+                               fresh):
+    tab, rep_next = _replica_ring_halo(
+        x, rep_in, rsend_idx, nrep_rsend_idx, nrep_rhalo_dst, rep_slots,
+        rep_ring_pos, rr_sizes, nrep_rr_sizes, halo_r, axis_name,
+        halo_dtype, fresh)
+    local = spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, x,
+                     buckets)
+    if fresh:
+        # the full ring concat folds through the exact ragged path's
+        # per-round redge_* scatter sequence (bit-identical — PR-6 contract)
+        remote = _stale_ragged_fold(tab, redge_dst, redge_src, redge_w,
+                                    rr_sizes, rr_edge_sizes, x.shape[0])
+    else:
+        # the shrunken ring lands in the halo TABLE (replica slots from the
+        # carry), folded by the dense halo-src edge family — replica steps
+        # are approximate between refreshes, so round-order parity is not a
+        # contract here
+        remote = spmm_local(hedge_dst, hedge_src, hedge_w, tab, x.shape[0])
+    return local + remote, rep_next
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(19, 20, 21, 22, 23, 24, 25, 26))
+def pspmm_replica_ragged(x, rep_in, grep_in, rsend_idx,
+                         nrep_rsend_idx, nrep_rhalo_dst, rep_slots,
+                         rep_ring_pos,
+                         ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+                         hedge_dst, hedge_src, hedge_w,
+                         redge_dst, redge_src, redge_w,
+                         buckets, rr_sizes, rr_edge_sizes, nrep_rr_sizes,
+                         halo_r, axis_name=AXIS, halo_dtype=None,
+                         fresh=False):
+    """``PSpMM`` with persistent hot-halo replicas on the ragged ring.
+
+    Replica (``fresh=False``) step: k−1 per-round ppermutes sized by the
+    SHRUNKEN ``nrep_rr_sizes`` (replicated rows off every round's wire,
+    both directions; empty rounds elided per ``ragged_live_rounds``), halo
+    replica slots filled from the carries.  Refresh (``fresh=True``) step:
+    the full ring whose round-major concat folds through ``redge_*`` —
+    f32-bit-identical to the exact ragged path, so ``--sync-every 1``
+    reproduces the no-replica trajectory — and both carries refresh
+    (features via ``rep_next`` at ``rep_ring_pos``, gradients via the
+    ``grep_in`` cotangent).  Returns ``(out, rep_next)``.  Symmetric-Â
+    only.
+    """
+    return _pspmm_replica_ragged_once(
+        x, rep_in, rsend_idx, nrep_rsend_idx, nrep_rhalo_dst, rep_slots,
+        rep_ring_pos, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+        hedge_dst, hedge_src, hedge_w, redge_dst, redge_src, redge_w,
+        buckets, rr_sizes, rr_edge_sizes, nrep_rr_sizes, halo_r, axis_name,
+        halo_dtype, fresh)
+
+
+def _pspmm_replica_ragged_fwd(x, rep_in, grep_in, rsend_idx,
+                              nrep_rsend_idx, nrep_rhalo_dst, rep_slots,
+                              rep_ring_pos, ell_idx, ell_w,
+                              ltail_dst, ltail_src, ltail_w,
+                              hedge_dst, hedge_src, hedge_w,
+                              redge_dst, redge_src, redge_w,
+                              buckets, rr_sizes, rr_edge_sizes,
+                              nrep_rr_sizes, halo_r, axis_name, halo_dtype,
+                              fresh):
+    out = _pspmm_replica_ragged_once(
+        x, rep_in, rsend_idx, nrep_rsend_idx, nrep_rhalo_dst, rep_slots,
+        rep_ring_pos, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+        hedge_dst, hedge_src, hedge_w, redge_dst, redge_src, redge_w,
+        buckets, rr_sizes, rr_edge_sizes, nrep_rr_sizes, halo_r, axis_name,
+        halo_dtype, fresh)
+    res = (grep_in, rsend_idx, nrep_rsend_idx, nrep_rhalo_dst, rep_slots,
+           rep_ring_pos, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+           hedge_dst, hedge_src, hedge_w, redge_dst, redge_src, redge_w)
+    return out, res
+
+
+def _pspmm_replica_ragged_bwd(buckets, rr_sizes, rr_edge_sizes,
+                              nrep_rr_sizes, halo_r, axis_name, halo_dtype,
+                              fresh, res, cts):
+    (grep_in, rsend_idx, nrep_rsend_idx, nrep_rhalo_dst, rep_slots,
+     rep_ring_pos, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+     hedge_dst, hedge_src, hedge_w, redge_dst, redge_src, redge_w) = res
+    g, _ = cts               # carry cotangent is structurally zero
+    gtab, grep_next = _replica_ring_halo(
+        g, grep_in, rsend_idx, nrep_rsend_idx, nrep_rhalo_dst, rep_slots,
+        rep_ring_pos, rr_sizes, nrep_rr_sizes, halo_r, axis_name,
+        halo_dtype, fresh)
+    if fresh:
+        gremote = _stale_ragged_fold(gtab, redge_dst, redge_src, redge_w,
+                                     rr_sizes, rr_edge_sizes, g.shape[0])
+    else:
+        gremote = spmm_local(hedge_dst, hedge_src, hedge_w, gtab,
+                             g.shape[0])
+    gx = (spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, g, buckets)
+          + gremote)
+    return (gx, None, grep_next, *[None] * 16)
+
+
+pspmm_replica_ragged.defvjp(_pspmm_replica_ragged_fwd,
+                            _pspmm_replica_ragged_bwd)
+
+
 # --------------------------------------------------------------------- stale
 # Pipelined one-step-stale exchange (PipeGCN-style, arXiv:2203.10428): layer ℓ
 # of step t aggregates with the halo received during step t−1, and step t's

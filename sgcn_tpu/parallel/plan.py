@@ -58,6 +58,9 @@ PER_CHIP_ARRAY_FIELDS = (
     "ptile_lsrc", "ptile_lld", "ptile_lw",
     "ptile_hsrc", "ptile_hld", "ptile_hw",
     "rsend_idx", "rhalo_dst", "redge_dst", "redge_src", "redge_w",
+    "nrep_send_idx", "nrep_send_counts", "nrep_halo_src",
+    "rep_slots", "rep_counts", "nrep_rsend_idx", "nrep_rhalo_dst",
+    "rep_ring_pos",
 )
 
 # Auto-selection threshold for SGCN_COMM_SCHEDULE=auto: below this dense-a2a
@@ -80,6 +83,29 @@ _GLOBAL_ARRAY_FIELDS = ("owner", "local_idx", "chip_ids")
 STALE_PLAN_FIELDS_RAGGED = (
     "rsend_idx", "ell_idx", "ell_w",
     "ltail_dst", "ltail_src", "ltail_w",
+    "redge_dst", "redge_src", "redge_w",
+)
+
+# Plan arrays the hot-halo REPLICATION step ships (``--replica-budget B``,
+# ``ops.pspmm.pspmm_replica`` / ``pspmm_replica_ragged``): the UNION of the
+# full exchange layout (the sync/refresh program is exactly the exact
+# program plus the replica-carry gathers) and the shrunken no-replica
+# layout (``ensure_replicas`` — top-B boundary rows by λ·degree leave the
+# per-layer wire; their halo slots fill from the carried replica table).
+# jit prunes whichever half a given program does not consume; the
+# plan-contract lint (tests/test_plan_contract.py, via analysis/registry)
+# covers both tuples.
+REPLICA_PLAN_FIELDS = (
+    "send_idx", "halo_src",
+    "nrep_send_idx", "nrep_halo_src", "rep_slots",
+    "ell_idx", "ell_w", "ltail_dst", "ltail_src", "ltail_w",
+    "hedge_dst", "hedge_src", "hedge_w",
+)
+REPLICA_PLAN_FIELDS_RAGGED = (
+    "rsend_idx", "nrep_rsend_idx", "nrep_rhalo_dst", "rep_slots",
+    "rep_ring_pos",
+    "ell_idx", "ell_w", "ltail_dst", "ltail_src", "ltail_w",
+    "hedge_dst", "hedge_src", "hedge_w",
     "redge_dst", "redge_src", "redge_w",
 )
 
@@ -218,6 +244,38 @@ class CommPlan:
     redge_src: np.ndarray | None = None  # (k, ΣE_d) int32 round recv-buffer row
     redge_w: np.ndarray | None = None    # (k, ΣE_d) float32, 0 on padding
 
+    # Hot-halo replication layout (lazy, ``ensure_replicas``): the top-B
+    # boundary rows by λ·degree (λ = consumer chips per row, degree = remote
+    # edges consuming it — both straight from the comm plan) are promoted to
+    # PERSISTENT REPLICAS on their consumer chips (CaPGNN-style,
+    # arXiv:2508.13716).  Replicated rows leave the per-layer wire entirely:
+    # the ``nrep_*`` layout is the send/receive structure with those rows
+    # deleted (per-pair buckets re-packed to the shrunken pad ``nrep_s``;
+    # per-round ring sizes shrunk to ``nrep_rr_sizes``), and ``rep_slots``
+    # names the halo-table ranks each chip fills from its carried replica
+    # table instead.  Refresh rides the FULL exchange on sync steps — the
+    # sync program IS the exact program plus carry gathers (``rep_ring_pos``
+    # locates each replica row in the full ring's round-major receive
+    # concat), which is what makes ``--sync-every 1`` f32-bit-identical to
+    # the no-replica path (docs/replication.md).
+    replica_budget: int | None = None     # the budget B ensure_replicas ran at
+    rp: int | None = None                 # padded replica slots per chip
+    replica_rows: int = 0                 # global replicated rows (<= B)
+    replica_send_saving: int = 0          # Σ λ_v — true rows off the wire
+    #                                       per exchange
+    rep_slots: np.ndarray | None = None   # (k, RP) halo ranks; r = pad (drop)
+    rep_counts: np.ndarray | None = None  # (k,) true replica slots per chip
+    nrep_s: int | None = None             # shrunken per-pair bucket pad
+    nrep_send_idx: np.ndarray | None = None     # (k, k, S') int32
+    nrep_send_counts: np.ndarray | None = None  # (k, k) int32
+    nrep_halo_src: np.ndarray | None = None     # (k, R) int32; replica slots
+    #                                             point at 0 (overwritten)
+    nrep_rr_sizes: tuple | None = None          # shrunken per-round sizes
+    nrep_rsend_idx: np.ndarray | None = None    # (k, ΣS'_d) int32
+    nrep_rhalo_dst: np.ndarray | None = None    # (k, ΣS'_d) int32; r = pad
+    rep_ring_pos: np.ndarray | None = None      # (k, RP) int32 into the full
+    #                                             (ΣS_d) ring concat
+
     # identities of the chips this (possibly sliced) plan's rows describe —
     # set by the shard proxy (``parallel/proxy.py``) so the comm-stat
     # properties zero each row's TRUE self-slot rather than assuming row i
@@ -309,23 +367,38 @@ class CommPlan:
         wire = self.wire_rows_per_exchange("a2a")
         return float(self.send_counts.sum()) / wire if wire else 1.0
 
-    def wire_rows_per_exchange(self, schedule: str = "a2a") -> int:
+    def wire_rows_per_exchange(self, schedule: str = "a2a",
+                               replica: bool = False) -> int:
         """Padded rows the selected schedule puts on the wire per exchange,
         over the chips in view (full plan: all k).  Dense a2a ships the
         whole (k, S) buffer per chip = k²·S rows; the ragged ring ships
         Σ_d S_d rows per chip = k·Σ_d S_d — the padded-vs-true accounting
         the roofline and CommStats report against ``predicted_send_volume``
-        (= Σ(λ−1), the true rows)."""
+        (= Σ(λ−1), the true rows).  ``replica=True`` prices the shrunken
+        NO-REPLICA exchange of a ``--replica-budget`` step
+        (``ensure_replicas``): the ``nrep_*`` pads replace ``s`` /
+        ``rr_sizes``."""
         rows, peers = np.asarray(self.send_counts).shape
+        if replica and self.rep_slots is None:
+            raise ValueError("build the replication layout first "
+                             "(ensure_replicas)")
         if schedule == "a2a":
-            return int(rows * peers * self.s)
+            return int(rows * peers * (self.nrep_s if replica else self.s))
         if schedule == "ragged":
-            sizes = (self.rr_sizes if self.rr_sizes is not None
-                     else self.ragged_round_sizes())
+            if replica:
+                if self.nrep_rr_sizes is None:
+                    raise ValueError(
+                        "ragged replica wire needs ensure_ragged() before "
+                        "ensure_replicas()")
+                sizes = self.nrep_rr_sizes
+            else:
+                sizes = (self.rr_sizes if self.rr_sizes is not None
+                         else self.ragged_round_sizes())
             return int(rows * sum(sizes))
         raise ValueError(f"unknown comm schedule {schedule!r}")
 
-    def wire_buffer_shapes(self, schedule: str = "a2a") -> list:
+    def wire_buffer_shapes(self, schedule: str = "a2a",
+                           replica: bool = False) -> list:
         """Static per-DISPATCH wire-buffer shapes of ONE halo exchange,
         WITHOUT the trailing lane axis (the per-layer table width is the
         model's business — ``models.gcn.exchange_widths`` /
@@ -334,19 +407,32 @@ class CommPlan:
         ``'a2a'``: one dispatch of the globally-padded ``(peers, S)`` bucket
         per exchange.  ``'ragged'``: one dispatch of ``(S_d,)`` per LIVE
         round (``ops.pspmm.ragged_live_rounds`` — empty rounds ship nothing
-        and vanish from the traced program).  This is the shape side of the
-        compiled-program wire contract the HLO audit
-        (``sgcn_tpu/analysis``) checks against every lowered step.
+        and vanish from the traced program).  ``replica=True``: the
+        shrunken no-replica exchange of a ``--replica-budget`` step — the
+        ``nrep_s`` pad / live rounds of ``nrep_rr_sizes`` (same elision
+        rule).  This is the shape side of the compiled-program wire
+        contract the HLO audit (``sgcn_tpu/analysis``) checks against
+        every lowered step.
         """
+        if replica and self.rep_slots is None:
+            raise ValueError("build the replication layout first "
+                             "(ensure_replicas)")
         if schedule == "a2a":
             peers = int(np.asarray(self.send_counts).shape[1])
-            return [(peers, self.s)]
+            return [(peers, self.nrep_s if replica else self.s)]
         if schedule == "ragged":
             # deferred: ops.pspmm imports jax; this module stays numpy-only
             from ..ops.pspmm import ragged_live_rounds
 
-            sizes = (self.rr_sizes if self.rr_sizes is not None
-                     else self.ragged_round_sizes())
+            if replica:
+                if self.nrep_rr_sizes is None:
+                    raise ValueError(
+                        "ragged replica wire needs ensure_ragged() before "
+                        "ensure_replicas()")
+                sizes = self.nrep_rr_sizes
+            else:
+                sizes = (self.rr_sizes if self.rr_sizes is not None
+                         else self.ragged_round_sizes())
             return [(int(sizes[d - 1]),)
                     for d in ragged_live_rounds(sizes)]
         raise ValueError(f"unknown comm schedule {schedule!r}")
@@ -450,6 +536,205 @@ class CommPlan:
         self.redge_src = redge_src
         self.redge_w = redge_w
         return self
+
+    # ----------------------------------------------------- hot-halo replicas
+    def replica_scores(self) -> tuple:
+        """Per (owner chip, local row): ``(λ, consumer-edge count)`` of every
+        owned row, straight from the comm plan — λ is the number of consumer
+        chips the row ships to per exchange (its send-list multiplicity) and
+        the edge count is how many remote halo-src edges reference it (the
+        aggregation work its replica would feed).  ``λ·edges`` is THE
+        replica ranking (ISSUE/ROADMAP: λ·degree); the native partitioner's
+        cache-aware objective ranks nets by the same quantity
+        ((λ−1)·pins in hypergraph terms — the owner part is a pin there).
+        Needs the full square plan."""
+        sc = np.asarray(self.send_counts)
+        if sc.ndim != 2 or sc.shape[0] != sc.shape[1]:
+            raise ValueError(
+                "replica selection needs the full square plan "
+                f"(send_counts {sc.shape}); build replicas with "
+                "ensure_replicas() BEFORE shard_proxy_plan slicing")
+        k, b, s = self.k, self.b, self.s
+        lam = np.zeros((k, b), np.int64)
+        cons = np.zeros((k, b), np.int64)
+        for q in range(k):
+            hs = int(self.halo_counts[q])
+            if not hs:
+                continue
+            hedge_cnt = np.bincount(self.hedge_src[q, : int(self.hnnz[q])],
+                                    minlength=self.r)
+            slots = np.asarray(self.halo_src[q, :hs])
+            o = slots // s
+            j = slots % s
+            rows = self.send_idx[o, q, j]
+            np.add.at(lam, (o, rows), 1)
+            np.add.at(cons, (o, rows), hedge_cnt[:hs])
+        return lam, cons
+
+    def ensure_replicas(self, budget: int) -> "CommPlan":
+        """Build the hot-halo replication layout for ``budget`` rows.
+
+        Selects the top-``budget`` boundary rows globally by λ·degree
+        (``replica_scores``; deterministic tie-break on (owner, row)), then
+        derives the shrunken no-replica exchange layout: per-pair send
+        buckets with those rows deleted (a2a) and, when the ragged layout
+        exists, the shrunken per-round ring (``nrep_rr_sizes`` +
+        send/receive maps).  Kept rows preserve their relative order on
+        both ends, so the shrunken receive side stays aligned with the
+        shrunken send side by construction.  A budget above the boundary
+        row count clamps (everything replicated — the communication-free
+        limit).  Idempotent per budget; call ``ensure_ragged()`` FIRST when
+        the ragged schedule is in play (the ring shrink needs the round
+        envelope, and ``rep_ring_pos`` indexes the full ring's concat).
+        """
+        if budget < 0:
+            raise ValueError(f"replica budget must be >= 0, got {budget}")
+        ring = self.rr_sizes is not None
+        if (self.replica_budget == budget and self.rep_slots is not None
+                and (not ring or self.nrep_rsend_idx is not None)):
+            return self
+        k, b, s, r = self.k, self.b, self.s, self.r
+        sc = np.asarray(self.send_counts)
+        lam, cons = self.replica_scores()
+        score = (lam * cons).ravel()
+        boundary = np.nonzero(lam.ravel() > 0)[0]
+        order = boundary[np.lexsort((boundary, -score[boundary]))]
+        chosen = order[:budget]
+        rep_mask = np.zeros(k * b, bool)
+        rep_mask[chosen] = True
+        rep_mask = rep_mask.reshape(k, b)
+        self.replica_rows = int(len(chosen))
+        self.replica_send_saving = int(lam.ravel()[chosen].sum())
+        # shrunken send buckets: kept entries keep their id-sorted order
+        nrep_counts = np.zeros((k, k), np.int32)
+        kept_lists: dict[tuple[int, int], np.ndarray] = {}
+        for p in range(k):
+            for q in range(k):
+                cnt = int(sc[p, q])
+                if not cnt:
+                    continue
+                rows = self.send_idx[p, q, :cnt]
+                kept = np.nonzero(~rep_mask[p, rows])[0]
+                kept_lists[(p, q)] = kept
+                nrep_counts[p, q] = len(kept)
+        nrep_s = max(1, int(nrep_counts.max()) if k else 1)
+        nrep_send_idx = np.zeros((k, k, nrep_s), np.int32)
+        for (p, q), kept in kept_lists.items():
+            nrep_send_idx[p, q, : len(kept)] = self.send_idx[p, q, kept]
+        # receive side: shrunken halo gather + replica slot lists.  Ring
+        # positions: round d's receive slice starts at Σ_{d'<d} S_d' and a
+        # slot's within-round position is its send-list position j
+        # (ensure_ragged's receive invariant).
+        offsets = (np.concatenate([[0], np.cumsum(self.rr_sizes)])
+                   if ring else None)
+        nrep_halo_src = np.zeros((k, r), np.int32)
+        rep_slot_lists, rep_ring_lists = [], []
+        for q in range(k):
+            hs = int(self.halo_counts[q])
+            if not hs:
+                rep_slot_lists.append(np.zeros(0, np.int64))
+                rep_ring_lists.append(np.zeros(0, np.int64))
+                continue
+            slots = np.asarray(self.halo_src[q, :hs])
+            o = slots // s
+            j = slots % s
+            rows = self.send_idx[o, q, j]
+            keep = ~rep_mask[o, rows]
+            newpos = np.zeros(hs, np.int64)
+            for oo in np.unique(o):
+                m = o == oo
+                newpos[m] = np.cumsum(keep[m]) - 1
+            nrep_halo_src[q, :hs] = np.where(
+                keep, o * nrep_s + newpos, 0).astype(np.int32)
+            reps = np.nonzero(~keep)[0]
+            rep_slot_lists.append(reps)
+            if ring:
+                d = (q - o) % k
+                rep_ring_lists.append(offsets[d[reps] - 1] + j[reps])
+            else:
+                rep_ring_lists.append(np.zeros(0, np.int64))
+        rp = max(1, max((len(x) for x in rep_slot_lists), default=0))
+        rep_slots = np.full((k, rp), r, np.int32)
+        rep_ring_pos = np.zeros((k, rp), np.int32)
+        for q in range(k):
+            rep_slots[q, : len(rep_slot_lists[q])] = rep_slot_lists[q]
+            if ring:
+                rep_ring_pos[q, : len(rep_ring_lists[q])] = \
+                    rep_ring_lists[q]
+        self.rep_counts = np.array([len(x) for x in rep_slot_lists],
+                                   np.int64)
+        self.rep_slots = rep_slots
+        self.rp = rp
+        self.nrep_s = nrep_s
+        self.nrep_send_idx = nrep_send_idx
+        self.nrep_send_counts = nrep_counts
+        self.nrep_halo_src = nrep_halo_src
+        self.rep_ring_pos = rep_ring_pos if ring else None
+        if ring:
+            idxk = np.arange(k)
+            nrr = tuple(int(nrep_counts[idxk, (idxk + d) % k].max())
+                        for d in range(1, k))
+            st = max(1, sum(nrr))
+            nrep_rsend_idx = np.zeros((k, st), np.int32)
+            nrep_rhalo_dst = np.full((k, st), r, np.int32)
+            off = 0
+            for d, sd in enumerate(nrr, start=1):
+                for p in range(k):
+                    q2 = (p + d) % k
+                    cnt = int(nrep_counts[p, q2])
+                    if cnt:
+                        nrep_rsend_idx[p, off: off + cnt] = \
+                            nrep_send_idx[p, q2, :cnt]
+                    o = (p - d) % k
+                    rc = int(nrep_counts[o, p])
+                    if rc:
+                        hs = int(self.halo_counts[p])
+                        slots = np.asarray(self.halo_src[p, :hs])
+                        oarr = slots // s
+                        rows = self.send_idx[oarr, p, slots % s]
+                        m = (oarr == o) & ~rep_mask[oarr, rows]
+                        ranks = np.nonzero(m)[0]
+                        if len(ranks) != rc:         # plan invariant
+                            raise ValueError(
+                                f"kept halo sublist of owner {o} on chip "
+                                f"{p} has {len(ranks)} rows, shrunken send "
+                                f"list says {rc}")
+                        nrep_rhalo_dst[p, off: off + rc] = \
+                            ranks.astype(np.int32)
+                off += sd
+            self.nrep_rr_sizes = nrr
+            self.nrep_rsend_idx = nrep_rsend_idx
+            self.nrep_rhalo_dst = nrep_rhalo_dst
+        self.replica_budget = int(budget)
+        return self
+
+    def replica_carry_shapes(self, fin: int, widths) -> dict:
+        """Per-layer replica-carry shapes (WITHOUT the stacked leading k
+        axis): one ``(RP, f_ℓ)`` feature-replica table and one gradient-
+        replica table per layer, at the layer's EXCHANGED width
+        (``models.gcn.exchange_widths`` — same lockstep rule as the stale
+        carries).  Requires ``ensure_replicas()`` first."""
+        from ..models.gcn import exchange_widths   # deferred: avoids a cycle
+
+        if self.rep_slots is None:
+            raise ValueError(
+                "replica carries need the replication layout; call "
+                "ensure_replicas() before replica_carry_shapes()")
+        fs = exchange_widths(fin, list(widths))
+        return {
+            "reps": [(self.rp, f) for f in fs],
+            "greps": [(self.rp, f) for f in fs],
+        }
+
+    @property
+    def replica_send_volume(self) -> np.ndarray:
+        """Per-chip TRUE boundary rows shipped per NO-REPLICA exchange (k,)
+        — ``predicted_send_volume`` minus each chip's replicated shipments
+        (send lists never hold self-slots, so no diagonal correction)."""
+        if self.nrep_send_counts is None:
+            raise ValueError("build the replication layout first "
+                             "(ensure_replicas)")
+        return self.nrep_send_counts.astype(np.int64).sum(axis=1)
 
     # ------------------------------------------------------------ stale halo
     def stale_carry_shapes(self, fin: int, widths, delta: bool = False,

@@ -1,5 +1,6 @@
 from .random_part import random_partition, balanced_random_partition
-from .native import partition_graph, partition_hypergraph_colnet
+from .native import (partition_graph, partition_hypergraph_colnet,
+                     partition_hypergraph_colnet_cache)
 from .emit import (
     read_buff, read_conn, read_partvec, read_partvec_pickle,
     write_partvec, write_partvec_pickle, write_rank_files,
@@ -8,6 +9,7 @@ from .emit import (
 __all__ = [
     "random_partition", "balanced_random_partition",
     "partition_graph", "partition_hypergraph_colnet",
+    "partition_hypergraph_colnet_cache",
     "read_buff", "read_conn", "read_partvec", "read_partvec_pickle",
     "write_partvec", "write_partvec_pickle", "write_rank_files",
 ]

@@ -59,6 +59,18 @@ def _load() -> ctypes.CDLL:
         np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
         ctypes.POINTER(ctypes.c_int64),
     ]
+    lib.sgcn_partition_hypergraph_cache.restype = ctypes.c_int
+    lib.sgcn_partition_hypergraph_cache.argtypes = [
+        ctypes.c_int32, ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_void_p,   # cwgt (nullable)
+        ctypes.c_int, ctypes.c_double, ctypes.c_int,
+        ctypes.c_int32,    # replica_budget
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
     _lib = lib
     return lib
 
@@ -111,3 +123,64 @@ def partition_hypergraph_colnet(a: sp.spmatrix, k: int,
     if rc != 0:
         raise RuntimeError(f"sgcn_partition_hypergraph failed rc={rc}")
     return part.astype(np.int64), int(km1.value)
+
+
+def partition_hypergraph_colnet_cache(
+        a: sp.spmatrix, k: int, replica_budget: int,
+        imbalance: float = 0.03,
+        seed: int = 1) -> tuple[np.ndarray, int, int]:
+    """Cache-aware column-net partition (hot-halo replication,
+    ``docs/replication.md``): the same RB/direct driver as
+    ``partition_hypergraph_colnet``, then the cut is CO-OPTIMIZED with the
+    replica budget — a net whose source vertex is replicated costs 0, so
+    refinement under zeroed weights stops fighting the cache.
+
+    Returns ``(partvec int64 (n,), km1, km1_cache)`` where ``km1_cache`` is
+    km1 minus the top-``replica_budget`` nets' contribution (selection by
+    (λ−1)·pins — the hypergraph face of the plan-time λ·degree ranking);
+    by construction ``km1_cache`` <= the same objective evaluated on the
+    cache-blind partition at equal seed/balance.
+    """
+    a = sp.csr_matrix(a)
+    n, m = a.shape
+    lib = _load()
+    part = np.empty(n, dtype=np.int32)
+    km1 = ctypes.c_int64(0)
+    km1_cache = ctypes.c_int64(0)
+    cwgt = np.maximum(np.diff(a.indptr), 1).astype(np.int64)
+    rc = lib.sgcn_partition_hypergraph_cache(
+        n, m, a.indptr.astype(np.int64), a.indices.astype(np.int32),
+        cwgt.ctypes.data_as(ctypes.c_void_p), k, imbalance, seed,
+        int(replica_budget), part, ctypes.byref(km1),
+        ctypes.byref(km1_cache))
+    if rc != 0:
+        raise RuntimeError(
+            f"sgcn_partition_hypergraph_cache failed rc={rc}")
+    return part.astype(np.int64), int(km1.value), int(km1_cache.value)
+
+
+def cache_aware_km1(a: sp.spmatrix, part: np.ndarray,
+                    replica_budget: int) -> int:
+    """Evaluate the cache-aware km1 objective of ANY partition — numpy
+    mirror of the native ``cache_objective`` (unweighted nets, the
+    column-net model's default): km1 = Σ_j (λ_j − 1) minus the
+    contribution of the top-``replica_budget`` nets by (λ−1)·pins
+    (deterministic net-id tie-break, like the native side).  The
+    cache-blind arm of the bench A/B is scored with THIS, so the native
+    co-optimizer's ≤ claim is checked against an independent
+    implementation."""
+    a = sp.csc_matrix(a)
+    part = np.asarray(part)
+    n_nets = a.shape[1]
+    lam = np.zeros(n_nets, np.int64)
+    pins = np.diff(a.indptr)
+    for j in range(n_nets):
+        rows = a.indices[a.indptr[j]: a.indptr[j + 1]]
+        if len(rows):
+            lam[j] = len(np.unique(part[rows]))
+    contrib = np.maximum(lam - 1, 0)
+    score = contrib * pins
+    cut = np.nonzero(lam >= 2)[0]
+    order = cut[np.lexsort((cut, -score[cut]))]
+    chosen = order[: max(0, int(replica_budget))]
+    return int(contrib.sum() - contrib[chosen].sum())

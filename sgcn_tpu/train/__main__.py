@@ -70,7 +70,20 @@ def main() -> None:
     p.add_argument("--sync-every", type=int, default=0,
                    help="stale mode: run a full-sync (exact-math) step "
                         "every N steps to bound staleness/quantization "
-                        "drift; 0 = only the initializing first step")
+                        "drift; replica mode: refresh the replica tables "
+                        "every N steps; 0 = only the initializing first "
+                        "step")
+    p.add_argument("--replica-budget", type=int, default=0,
+                   help="hot-halo replication (docs/replication.md): "
+                        "promote the top-B boundary rows (by λ·degree from "
+                        "the comm plan) to persistent replicas on their "
+                        "consumer chips — they leave the per-layer wire "
+                        "entirely, refreshed only on --sync-every refresh "
+                        "steps (at --sync-every 1 the trajectory is f32-"
+                        "bit-identical to the no-replica path); full-batch "
+                        "GCN, symmetric adjacency, f32; composes with "
+                        "--comm-schedule a2a/ragged and --halo-dtype; "
+                        "0 = off")
     p.add_argument("--comm-schedule", default=None,
                    choices=["a2a", "ragged", "auto"],
                    help="halo transport (docs/comm_schedule.md): a2a = "
@@ -151,10 +164,28 @@ def main() -> None:
             "attention tables, the accuracy-parity harness is defined for "
             "the exact exchange, and the carries are f32 state — drop the "
             "conflicting flag)")
-    if (args.halo_delta or args.sync_every) and not args.halo_staleness:
+    if args.halo_delta and not args.halo_staleness:
         raise SystemExit(
-            "--halo-delta/--sync-every configure the stale pipelined "
-            "exchange; add --halo-staleness 1")
+            "--halo-delta configures the stale pipelined exchange; add "
+            "--halo-staleness 1")
+    if args.sync_every and not (args.halo_staleness or args.replica_budget):
+        raise SystemExit(
+            "--sync-every schedules the stale mode's full-sync steps or "
+            "the replica mode's refresh steps; add --halo-staleness 1 or "
+            "--replica-budget B")
+    if args.replica_budget and (args.batch_size is not None
+                                or args.model != "gcn"
+                                or args.experiment == "accuracy"
+                                or args.dtype
+                                or args.halo_staleness):
+        raise SystemExit(
+            "--replica-budget replicates rows of the full-batch GCN "
+            "exchange only (the mini-batch trainer re-plans per batch, so "
+            "replica carries have no stable identity across batch plans; "
+            "GAT ships per-layer attention tables; the accuracy-parity "
+            "harness is defined for the exact exchange; the carries are "
+            "f32 state; composition with --halo-staleness 1 is deferred — "
+            "drop the conflicting flag)")
     # --comm-schedule ragged composes with --halo-staleness 1 since the
     # round-structured stale carry (pspmm_stale_ragged); the remaining
     # genuinely unsupported combo is the accuracy-parity harness, which is
@@ -314,7 +345,8 @@ def main() -> None:
                                   halo_staleness=args.halo_staleness,
                                   halo_delta=args.halo_delta,
                                   sync_every=args.sync_every,
-                                  comm_schedule=args.comm_schedule)
+                                  comm_schedule=args.comm_schedule,
+                                  replica_budget=args.replica_budget)
             if recorder is not None:
                 recorder.set_plan(plan, partitioner={"partvec": args.partvec,
                                                      "k": k})

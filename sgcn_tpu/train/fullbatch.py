@@ -111,13 +111,20 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
                           model: str = "gcn",
                           comm_schedule: str | None = None,
                           compute_dtype: str | None = None,
-                          halo_staleness: int = 0) -> ForwardSetup:
+                          halo_staleness: int = 0,
+                          replica_budget: int = 0) -> ForwardSetup:
     """Resolve (schedule, shipped plan fields, static forward kwargs) for one
     plan — the selection logic that used to live inline in
     ``FullBatchTrainer.__init__``, factored out so the forward-only serve
     engine rides the identical rules.  Builds the lazy plan layouts the
     selection needs (``ensure_ragged``, ``ensure_cell``,
-    ``ensure_pallas_tiles``) as side effects, exactly as the trainer did."""
+    ``ensure_pallas_tiles``, ``ensure_replicas``) as side effects, exactly
+    as the trainer did.  ``replica_budget`` is a TRAINING-only lever (the
+    trainer gates it; serving always runs the exact forward and never
+    passes it): it swaps the shipped fields for the replica union tuples —
+    ``fwd_static`` stays the EXACT forward's statics, because evaluation
+    and serving ride ``gcn_forward_local`` on the same (superset) plan
+    arrays, with jit pruning the ``nrep_*`` half."""
     from ..parallel.plan import resolve_comm_schedule
 
     decision: dict = {}
@@ -149,12 +156,27 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
                       "comm_schedule": "ragged",
                       "rr_sizes": plan.rr_sizes,
                       "rr_edge_sizes": plan.rr_edge_sizes}
-    if model == "gcn" and not halo_staleness and comm_schedule == "a2a":
+    if model == "gcn" and replica_budget:
+        # hot-halo replication (docs/replication.md): the shipped fields
+        # are the UNION of the full exchange layout (the sync/refresh
+        # program = the exact program + replica gathers; evaluate() rides
+        # it) and the shrunken no-replica layout; fwd_static stays the
+        # exact forward's statics — the replica-only statics
+        # (nrep_rr_sizes, halo table height) live on the trainer
+        from ..parallel.plan import (REPLICA_PLAN_FIELDS,
+                                     REPLICA_PLAN_FIELDS_RAGGED)
+        plan.ensure_replicas(replica_budget)
+        plan_fields = (REPLICA_PLAN_FIELDS_RAGGED
+                       if comm_schedule == "ragged" else REPLICA_PLAN_FIELDS)
+    if model == "gcn" and not halo_staleness and not replica_budget \
+            and comm_schedule == "a2a":
         # plan-driven kernel choice (VERDICT r3 #9): per-chip tables in
         # the VMEM regime switch the aggregator to the Pallas kernel.
         # The stale mode stays on the ELL aggregator: pspmm_stale's
         # carry contract is built around it, and hiding the exchange
-        # removes the latency the VMEM kernel would have overlapped.
+        # removes the latency the VMEM kernel would have overlapped; the
+        # replica mode likewise — its halo-table assembly and carry
+        # contract are built around the ELL + hedge fold.
         from ..ops.pallas_spmm import PALLAS_PLAN_FIELDS, use_pallas_spmm
         if use_pallas_spmm(plan, fin, widths):
             plan.ensure_pallas_tiles()
@@ -311,6 +333,7 @@ class FullBatchTrainer:
         halo_delta: bool = False,
         sync_every: int = 0,
         comm_schedule: str | None = None,
+        replica_budget: int = 0,
     ):
         """``compute_dtype='bfloat16'`` runs forward/backward (including the
         halo exchange — half the ICI bytes) in bf16 with f32 master params
@@ -368,7 +391,23 @@ class FullBatchTrainer:
         ``halo_staleness=1`` is the COMPOSED mode
         (``ops/pspmm.py::pspmm_stale_ragged``): round-structured carries
         ride the ring across steps, so both the Σ(λ−1) wire win and the
-        hidden-exchange critical-path win apply at once."""
+        hidden-exchange critical-path win apply at once.
+
+        ``replica_budget=B`` (B > 0) enables HOT-HALO REPLICATION
+        (CaPGNN-style, ``docs/replication.md``): the plan's top-B boundary
+        rows by λ·degree live as persistent per-layer replicas on their
+        consumer chips (``CommPlan.ensure_replicas``), leaving the
+        per-layer wire entirely — both directions ship the shrunken
+        ``nrep_*`` buckets/ring and fill the replica halo slots from
+        carried tables.  Step 0 and every ``sync_every``-th step run the
+        REFRESH program: the full exact exchange (f32-bit-identical math —
+        ``--sync-every 1`` reproduces the no-replica trajectory exactly)
+        with the replica tables re-read fresh as a byproduct.  Unlike
+        ``halo_staleness``, every exchange stays synchronous: replication
+        shrinks wire bytes (``halo_bytes_true`` is the gauge), not
+        exposure.  GCN + symmetric Â + f32 non-remat only; composition
+        with ``halo_staleness=1`` is deferred with a clean error;
+        evaluation always runs the exact forward."""
         if halo_dtype is not None and model != "gcn":
             raise ValueError(
                 "halo_dtype is a GCN-trainer lever; for GAT use "
@@ -384,10 +423,39 @@ class FullBatchTrainer:
                 "requires halo_staleness=1")
         if sync_every < 0:
             raise ValueError(f"sync_every must be >= 0, got {sync_every}")
-        if sync_every and not halo_staleness:
+        if sync_every and not (halo_staleness or replica_budget):
             raise ValueError(
-                "sync_every schedules the stale mode's full-sync steps; it "
-                "requires halo_staleness=1 (exact mode is always in sync)")
+                "sync_every schedules the stale mode's full-sync steps / "
+                "the replica mode's refresh steps; it requires "
+                "halo_staleness=1 or replica_budget>0 (exact mode is "
+                "always in sync)")
+        if replica_budget < 0:
+            raise ValueError(
+                f"replica_budget must be >= 0, got {replica_budget}")
+        if replica_budget:
+            if model != "gcn":
+                raise ValueError(
+                    "replica_budget replicates rows of the GCN feature "
+                    "exchange; the GAT exchange ships per-layer attention "
+                    "tables whose replication is not supported")
+            if halo_staleness:
+                raise ValueError(
+                    "replica_budget composed with halo_staleness=1 is "
+                    "deferred: the stale carries and the replica carries "
+                    "would share the sync schedule but disagree on what a "
+                    "non-sync exchange ships — run one lever at a time "
+                    "(docs/replication.md)")
+            if not plan.symmetric:
+                raise ValueError(
+                    "replica_budget uses the symmetric-Â custom backward "
+                    "(gradient replicas mirror the feature replicas); this "
+                    "plan is asymmetric — run without replication")
+            if compute_dtype is not None or remat:
+                raise ValueError(
+                    "replica_budget is defined for the f32 non-remat "
+                    "trainer (replica carries are f32 state threaded "
+                    "through the step); drop compute_dtype/remat or run "
+                    "without replication")
         if halo_staleness:
             if model != "gcn":
                 raise ValueError(
@@ -414,7 +482,8 @@ class FullBatchTrainer:
         # f32 non-remat) already cover the genuinely unsupported combos.
         setup = resolve_forward_setup(
             plan, fin, widths, model=model, comm_schedule=comm_schedule,
-            compute_dtype=compute_dtype, halo_staleness=halo_staleness)
+            compute_dtype=compute_dtype, halo_staleness=halo_staleness,
+            replica_budget=replica_budget)
         self.comm_decision = setup.decision   # selection → run manifest
         comm_schedule = setup.comm_schedule
         self.comm_schedule = comm_schedule
@@ -422,6 +491,7 @@ class FullBatchTrainer:
         self.halo_delta = halo_delta
         self.sync_every = sync_every
         self.halo_dtype = halo_dtype
+        self.replica_budget = replica_budget
         self.plan = plan
         self.fin = fin
         self.widths = list(widths)
@@ -499,6 +569,11 @@ class FullBatchTrainer:
                                          lane_widths=lane_widths,
                                          wire_itemsize=wire_itemsize,
                                          wire_itemsize_bwd=wire_itemsize_bwd)
+        if replica_budget:
+            # the shrunken no-replica exchange's per-rank/wire figures —
+            # count_step(replica=True) books replica steps at these, so
+            # the cumulative gauges reconcile with the per-step roofline
+            self.stats.set_replica(plan)
         self._step = self._build_step()
         self._eval = self._build_eval()
         self._multi = {}        # epochs -> compiled on-device epoch loop
@@ -521,6 +596,30 @@ class FullBatchTrainer:
             self._step_stale = self._build_step_stale(fresh=False)
             self._step_sync = self._build_step_stale(fresh=True)
             self._multi_stale = {}   # epochs -> compiled stale epoch loop
+        if replica_budget:
+            # per-layer feature/gradient replica tables, stacked per chip
+            # and sharded like the plan arrays; zeros are never consumed —
+            # step 0 (and every sync_every-th step) runs the refresh
+            # program, which reads the FULL exchange and refreshes every
+            # carry as a byproduct (plan.replica_carry_shapes).
+            self._rep_static = (
+                {"comm_schedule": "ragged",
+                 "rr_sizes": plan.rr_sizes,
+                 "rr_edge_sizes": plan.rr_edge_sizes,
+                 "nrep_rr_sizes": plan.nrep_rr_sizes,
+                 "halo_r": plan.r}
+                if comm_schedule == "ragged" else {"comm_schedule": "a2a"})
+            shapes = plan.replica_carry_shapes(fin, widths)
+            carry = {
+                name: [np.zeros((plan.k,) + s, np.float32) for s in shps]
+                for name, shps in shapes.items()
+            }
+            self.replica_carry = shard_stacked(self.mesh, carry)
+            self._rep_step_idx = 0
+            self._last_refresh_idx = 0    # refresh-age gauge anchor
+            self._step_rep = self._build_step_replica(fresh=False)
+            self._step_rep_sync = self._build_step_replica(fresh=True)
+            self._multi_rep = {}     # epochs -> compiled replica epoch loop
 
     # ------------------------------------------------------------------ build
     def _forward(self, params, pa, h0):
@@ -750,6 +849,194 @@ class FullBatchTrainer:
             wire_itemsize=4 if (self.halo_delta and sync_step) else None)
         return loss, err, extra
 
+    # ---------------------------------------------------- hot-halo replicas
+    def _forward_replica(self, params, pa, h0, reps, greps, fresh: bool):
+        from ..models.gcn import gcn_forward_local_replica
+
+        logits, new_reps = gcn_forward_local_replica(
+            params, h0, pa, reps, greps,
+            activation=self.activation,
+            final_activation=self.final_activation,
+            ell_buckets=self._fwd_static["ell_buckets"],
+            halo_dtype=self.halo_dtype,
+            fresh=fresh,
+            **self._rep_static,
+        )
+        return logits.astype("float32"), new_reps
+
+    def _one_step_replica(self, params, opt_state, carry, pa, h0, labels,
+                          valid, fresh: bool, telemetry: bool = False):
+        """One per-chip training step under hot-halo replication.
+
+        The gradient-replica carries ride jax's cotangent machinery exactly
+        like the stale mode's ``ghalos``: the loss is differentiated w.r.t.
+        ``(params, greps)`` and ``pspmm_replica``'s custom VJP returns, as
+        the "gradient" of each ``greps[ℓ]``, the refreshed gradient-replica
+        table on sync steps (the carry itself on replica steps).
+
+        ``telemetry=True`` additionally returns ``(gnorm, gauges)`` — the
+        replica drift gauges (``docs/replication.md``), psum'd to global
+        scalars: ``drift_sq[ℓ]`` = ``Σ (rep_next − rep_in)²`` (the drift a
+        refresh erased; identically zero on replica steps, whose carries
+        pass through) and ``ref_sq[ℓ]`` = ``Σ rep_next²``, its normalizer.
+        """
+        reps, greps = carry["reps"], carry["greps"]
+
+        def loss_fn(ps, gr):
+            logits, nr = self._forward_replica(ps, pa, h0, reps, gr, fresh)
+            loss = self._loss_fn(logits, labels, valid)
+            err = (masked_err_local(logits, labels, valid)
+                   if self.loss_name == "bce" else loss)
+            return loss, (err, nr)
+
+        (loss, (err, nr)), (grads, ngr) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(params, greps)
+        grads = jax.tree.map(lambda g: lax.psum(g, AXIS), grads)
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        new_carry = {"reps": nr, "greps": list(ngr)}
+        if not telemetry:
+            return params, opt_state, new_carry, loss, err
+        import jax.numpy as jnp
+        gauges = {
+            "drift_sq": jnp.stack([
+                lax.psum(jnp.sum(jnp.square(n - o)), AXIS)
+                for n, o in zip(nr, reps)]),
+            "ref_sq": jnp.stack([
+                lax.psum(jnp.sum(jnp.square(n)), AXIS) for n in nr]),
+        }
+        return (params, opt_state, new_carry, loss, err,
+                _global_grad_norm(grads), gauges)
+
+    def _build_step_replica(self, fresh: bool, telemetry: bool = False):
+        def per_chip(params, opt_state, carry, pa, h0, labels, valid):
+            carry, pa, h0, labels, valid = _unblock(
+                (carry, pa, h0, labels, valid))
+            out = self._one_step_replica(
+                params, opt_state, carry, pa, h0, labels, valid, fresh,
+                telemetry=telemetry)
+            params, opt_state, carry = out[:3]
+            return (params, opt_state, _reblock(carry)) + out[3:]
+
+        smapped = jax.shard_map(
+            per_chip,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+            out_specs=(P(), P(), P(AXIS), P(), P()) + ((P(), P())
+                                                       if telemetry else ()),
+        )
+        return jax.jit(smapped, donate_argnums=(0, 1, 2))
+
+    def _build_multi_replica(self, epochs: int):
+        """``epochs`` REPLICA (non-refresh) steps as one on-device
+        fori_loop; refresh steps are scheduled around the loop by
+        ``run_epochs`` (cf. ``_build_multi_stale``)."""
+        def per_chip(params, opt_state, carry, pa, h0, labels, valid, z):
+            carry, pa, h0, labels, valid = _unblock(
+                (carry, pa, h0, labels, valid))
+
+            def body(i, st):
+                params, opt_state, carry, losses, errs = st
+                params, opt_state, carry, loss, err = \
+                    self._one_step_replica(
+                        params, opt_state, carry, pa, h0, labels, valid,
+                        False)
+                return (params, opt_state, carry, losses.at[i].set(loss),
+                        errs.at[i].set(err))
+
+            params, opt_state, carry, losses, errs = lax.fori_loop(
+                0, epochs, body, (params, opt_state, carry, z, z))
+            return params, opt_state, _reblock(carry), losses, errs
+
+        smapped = jax.shard_map(
+            per_chip,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                      P()),
+            out_specs=(P(), P(), P(AXIS), P(), P()),
+        )
+        return jax.jit(smapped, donate_argnums=(0, 1, 2))
+
+    def _replica_sync_due(self) -> bool:
+        """Carry init (step 0) + the periodic refresh schedule.  With
+        ``sync_every=0`` only step 0 refreshes — replicas then age for the
+        whole run (the drift gauges are the signal that that was too
+        lax)."""
+        if self._rep_step_idx == 0:
+            return True
+        return bool(self.sync_every) and \
+            self._rep_step_idx % self.sync_every == 0
+
+    def _replica_run_one(self, data: TrainData):
+        """One replica-mode optimizer step (refresh or shrunken-wire per
+        schedule).  Returns ``(loss, err, extra)`` with ``extra`` =
+        ``(gnorm, gauges, refresh_age, sync_step)`` under telemetry."""
+        sync_step = self._replica_sync_due()
+        age = self._rep_step_idx - self._last_refresh_idx
+        first = sync_step and self._rep_step_idx == 0
+        if self.recorder is not None:
+            prog = (self._step_rep_sync_tel if sync_step
+                    else self._step_rep_tel)
+            (self.params, self.opt_state, self.replica_carry, loss, err,
+             gnorm, gauges) = prog(
+                self.params, self.opt_state, self.replica_carry, self.pa,
+                data.h0, data.labels, data.train_valid,
+            )
+            extra = (gnorm, gauges, age, sync_step, first)
+        else:
+            prog = self._step_rep_sync if sync_step else self._step_rep
+            (self.params, self.opt_state, self.replica_carry, loss,
+             err) = prog(
+                self.params, self.opt_state, self.replica_carry, self.pa,
+                data.h0, data.labels, data.train_valid,
+            )
+            extra = None
+        if sync_step:
+            self._last_refresh_idx = self._rep_step_idx
+        self._rep_step_idx += 1
+        # replica steps ship the shrunken wire (and the shrunken TRUE
+        # volume — replicated rows genuinely leave the exchange); refresh
+        # steps ship the full exact exchange
+        self.stats.count_step(nlayers=self.nlayers, replica=not sync_step)
+        return loss, err, extra
+
+    def _run_epochs_replica(self, data: TrainData, epochs: int, sync: bool):
+        return self._run_epochs_carried(
+            data, epochs, sync,
+            sync_due=self._replica_sync_due, run_one=self._replica_run_one,
+            multi=self._multi_rep, build_multi=self._build_multi_replica,
+            carry_attr="replica_carry", idx_attr="_rep_step_idx",
+            count_kwargs={"replica": True})
+
+    @staticmethod
+    def _replica_fields(gauges: dict, age: int, sync_step: bool,
+                        replica_rows: int,
+                        first_refresh: bool = False) -> dict:
+        """Host-side rendering of the in-graph replica gauges into the
+        schema's ``replica`` block (``obs.schema.REPLICA_KEYS``): per-layer
+        ‖replica − fresh‖ at each refresh (zero between refreshes — fresh
+        values only exist on the wire when a refresh ships them) plus the
+        refresh age of the consumed tables.  ``first_refresh`` (step 0)
+        reports ZERO drift: the in-graph gauge there compares against the
+        zero-initialized carry, so it measures initialization magnitude,
+        not drift any refresh erased — feeding it to the operator would
+        dominate every max/mean in the rendered report."""
+        import numpy as np
+
+        d = np.sqrt(np.maximum(np.asarray(gauges["drift_sq"], np.float64),
+                               0))
+        r = np.sqrt(np.maximum(np.asarray(gauges["ref_sq"], np.float64), 0))
+        if first_refresh:
+            d = np.zeros_like(d)
+        return {
+            "refresh_age": int(age),
+            "sync_step": bool(sync_step),
+            "replica_rows": int(replica_rows),
+            "replica_drift_rms": [float(x) for x in d],
+            "replica_drift_rel": [float(x / max(y, 1e-30))
+                                  for x, y in zip(d, r)],
+        }
+
     def _build_step(self, mesh=None, telemetry: bool = False):
         def per_chip(params, opt_state, pa, h0, labels, valid):
             pa, h0, labels, valid = _unblock((pa, h0, labels, valid))
@@ -778,9 +1065,11 @@ class FullBatchTrainer:
         ``kind`` selects which of the trainer's step programs to lower:
         ``'step'`` the exact-mode step; ``'stale'`` / ``'sync'`` the
         pipelined stale-mode step and its periodic full-sync flavor
-        (``halo_staleness=1`` trainers only; these include the halo-carry
-        inputs and lower on the trainer's own mesh — the stale builders are
-        mesh-bound).
+        (``halo_staleness=1`` trainers only); ``'rep'`` / ``'rep_sync'``
+        the hot-halo-replication step (shrunken wire) and its refresh
+        flavor (``replica_budget>0`` trainers only).  The carry-threading
+        kinds include the carry inputs and lower on the trainer's own mesh
+        — those builders are mesh-bound.
 
         Two consumers: the overlap evidence test
         (``tests/test_overlap_hlo.py``) compiles the real multi-chip TPU
@@ -793,17 +1082,21 @@ class FullBatchTrainer:
         donation contracts of the lowered module."""
         from jax.sharding import NamedSharding
 
-        if kind not in ("step", "stale", "sync"):
+        if kind not in ("step", "stale", "sync", "rep", "rep_sync"):
             raise ValueError(f"unknown step kind {kind!r}")
-        if kind != "step":
-            if not self.halo_staleness:
-                raise ValueError(
-                    f"kind={kind!r} lowers the stale-mode programs; this "
-                    "trainer runs exact mode (halo_staleness=0)")
-            if mesh not in (None, self.mesh):
-                raise ValueError(
-                    "stale step programs are built against the trainer's "
-                    "own mesh; pass mesh=None for kind='stale'/'sync'")
+        if kind in ("stale", "sync") and not self.halo_staleness:
+            raise ValueError(
+                f"kind={kind!r} lowers the stale-mode programs; this "
+                "trainer runs exact mode (halo_staleness=0)")
+        if kind in ("rep", "rep_sync") and not self.replica_budget:
+            raise ValueError(
+                f"kind={kind!r} lowers the replica-mode programs; this "
+                "trainer runs without replication (replica_budget=0)")
+        if kind != "step" and mesh not in (None, self.mesh):
+            raise ValueError(
+                "carry-threading step programs are built against the "
+                "trainer's own mesh; pass mesh=None for "
+                "kind='stale'/'sync'/'rep'/'rep_sync'")
         mesh = self.mesh if mesh is None else mesh
         fin = self.fin if fin is None else fin
         rep = NamedSharding(mesh, P())
@@ -820,8 +1113,13 @@ class FullBatchTrainer:
         labels = jax.ShapeDtypeStruct((k, b), np.int32, sharding=shd)
         valid = jax.ShapeDtypeStruct((k, b), np.float32, sharding=shd)
         if kind != "step":
-            carry = jax.tree.map(lambda x: sds(x, shd), self.halo_carry)
-            prog = self._step_stale if kind == "stale" else self._step_sync
+            live = (self.halo_carry if kind in ("stale", "sync")
+                    else self.replica_carry)
+            carry = jax.tree.map(lambda x: sds(x, shd), live)
+            prog = {"stale": getattr(self, "_step_stale", None),
+                    "sync": getattr(self, "_step_sync", None),
+                    "rep": getattr(self, "_step_rep", None),
+                    "rep_sync": getattr(self, "_step_rep_sync", None)}[kind]
             return prog.lower(params, opt_state, carry, pa, h0, labels,
                               valid)
         return self._build_step(mesh=mesh).lower(
@@ -887,6 +1185,8 @@ class FullBatchTrainer:
             return losses
         if self.halo_staleness:
             return self._run_epochs_stale(data, epochs, sync)
+        if self.replica_budget:
+            return self._run_epochs_replica(data, epochs, sync)
         if epochs not in self._multi:
             self._multi[epochs] = self._build_multi(epochs)
         self.params, self.opt_state, losses, errs = self._multi[epochs](
@@ -899,13 +1199,32 @@ class FullBatchTrainer:
         return np.asarray(losses) if sync else losses
 
     def _run_epochs_stale(self, data: TrainData, epochs: int, sync: bool):
+        return self._run_epochs_carried(
+            data, epochs, sync,
+            sync_due=self._stale_sync_due, run_one=self._stale_run_one,
+            multi=self._multi_stale, build_multi=self._build_multi_stale,
+            carry_attr="halo_carry", idx_attr="_stale_step_idx",
+            count_kwargs={"hidden": True})
+
+    def _run_epochs_carried(self, data: TrainData, epochs: int, sync: bool,
+                            *, sync_due, run_one, multi, build_multi,
+                            carry_attr: str, idx_attr: str,
+                            count_kwargs: dict):
+        """The shared carried-epoch loop of the stale and replica modes:
+        sync/refresh steps (per ``sync_due``) dispatch individually through
+        ``run_one`` (which also advances the step index and books stats);
+        the stretches between them run as ONE on-device fori_loop over the
+        ``build_multi`` program, with the carry threading through
+        ``carry_attr``.  One implementation — the two modes differ only in
+        which carry, which sync predicate, and how ``count_step`` books
+        the fused steps (hidden vs replica)."""
         import jax.numpy as jnp
 
         parts, err_parts = [], []
         left = epochs
         while left > 0:
-            if self._stale_sync_due():
-                loss, err, _ = self._stale_run_one(data)
+            if sync_due():
+                loss, err, _ = run_one(data)
                 parts.append(jnp.reshape(loss, (1,)))
                 err_parts.append(jnp.reshape(err, (1,)))
                 left -= 1
@@ -913,19 +1232,20 @@ class FullBatchTrainer:
             run = left
             if self.sync_every:
                 until_sync = (self.sync_every
-                              - self._stale_step_idx % self.sync_every)
+                              - getattr(self, idx_attr) % self.sync_every)
                 run = min(left, until_sync)
-            if run not in self._multi_stale:
-                self._multi_stale[run] = self._build_multi_stale(run)
-            (self.params, self.opt_state, self.halo_carry, losses,
-             errs) = self._multi_stale[run](
-                self.params, self.opt_state, self.halo_carry, self.pa,
-                data.h0, data.labels, data.train_valid,
+            if run not in multi:
+                multi[run] = build_multi(run)
+            (self.params, self.opt_state, carry, losses,
+             errs) = multi[run](
+                self.params, self.opt_state, getattr(self, carry_attr),
+                self.pa, data.h0, data.labels, data.train_valid,
                 np.zeros((run,), np.float32),
             )
-            self._stale_step_idx += run
+            setattr(self, carry_attr, carry)
+            setattr(self, idx_attr, getattr(self, idx_attr) + run)
             for _ in range(run):
-                self.stats.count_step(nlayers=self.nlayers, hidden=True)
+                self.stats.count_step(nlayers=self.nlayers, **count_kwargs)
             parts.append(losses)
             err_parts.append(errs)
             left -= run
@@ -977,13 +1297,22 @@ class FullBatchTrainer:
                 fresh=False, telemetry=True)
             self._step_sync_tel = self._build_step_stale(
                 fresh=True, telemetry=True)
+        if self.replica_budget:
+            self._step_rep_tel = self._build_step_replica(
+                fresh=False, telemetry=True)
+            self._step_rep_sync_tel = self._build_step_replica(
+                fresh=True, telemetry=True)
 
     def _step_cost_model(self, sync_step: bool = True):
         """Per-step-kind analytic cost: under ``--halo-delta`` the FEATURE
         wire is bf16 on stale steps but full f32 on (re-base) sync steps,
         while the gradient wire keeps ``--halo-dtype`` — so the cost model
         takes a per-direction wire-itemsize split and is cached per step
-        kind (the obs glossary documents the split)."""
+        kind (the obs glossary documents the split).  Under
+        ``--replica-budget`` a non-sync step prices the SHRUNKEN exchange
+        (``step_cost(replica=True)``): replicated rows leave both the true
+        and the wire volume, which is exactly what ``count_step``'s
+        replica booking accumulates — the gauges reconcile per step."""
         key = bool(sync_step)
         if key not in self._cost_cache:
             from ..obs.attribution import step_cost
@@ -1006,11 +1335,13 @@ class FullBatchTrainer:
                 compute_dtype=self.compute_dtype,
                 wire_itemsize=wire,
                 comm_schedule=self.comm_schedule,
-                model=self.model)
+                model=self.model,
+                replica=bool(self.replica_budget) and not sync_step)
         return self._cost_cache[key]
 
     def _record_step_event(self, loss: float, err, gnorm, wall_s: float,
-                           drift: dict | None) -> None:
+                           drift: dict | None,
+                           replica: dict | None = None) -> None:
         from ..obs.attribution import roofline_fields
         from ..obs.tracing import measured_vs_model_block
 
@@ -1023,6 +1354,11 @@ class FullBatchTrainer:
         # which is what makes the wire gauges reconcile with CommStats'.
         if "pallas_tb" not in self._fwd_static:
             sync_like = drift is None or bool(drift.get("sync_step"))
+            if replica is not None:
+                # replica steps price the shrunken exchange; refresh steps
+                # the full one.  Exposure is NOT affected — every replica-
+                # mode exchange has a same-step consumer (unlike staleness)
+                sync_like = bool(replica.get("sync_step"))
             cost = self._step_cost_model(sync_like)
             ex_step = 2 * self.nlayers      # this step's exchanges
             exposed_step = 0 if (drift is not None
@@ -1042,6 +1378,7 @@ class FullBatchTrainer:
             comm=self.stats.report(),
             phases=self.timer.report() or None,
             drift=drift,
+            replica=replica,
             roofline=roofline,
             measured_vs_model=mvm,
         )
@@ -1115,6 +1452,24 @@ class FullBatchTrainer:
                         rr_sizes=(self.plan.rr_sizes
                                   if self.comm_schedule == "ragged"
                                   else None)))
+                return loss
+            return float(loss) if sync else loss
+        if self.replica_budget:
+            cm = (self.spans.span("step", step=self._step_count + 1)
+                  if self.recorder is not None else contextlib.nullcontext())
+            with cm as sp:
+                loss, err, extra = self._replica_run_one(data)
+                if self.recorder is not None:
+                    loss = float(loss)
+            self.last_err = err
+            self._step_count += 1
+            if self.recorder is not None:
+                gnorm, gauges, age, sync_step, first = extra
+                self._record_step_event(
+                    loss, err, gnorm, sp.dur_s, drift=None,
+                    replica=self._replica_fields(
+                        gauges, age, sync_step, self.plan.replica_rows,
+                        first_refresh=first))
                 return loss
             return float(loss) if sync else loss
         if self.recorder is not None:

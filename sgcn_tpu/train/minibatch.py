@@ -85,7 +85,20 @@ class MiniBatchTrainer:
         pad_rows_to: int = 8,
         compute_dtype: str | None = None,
         comm_schedule: str | None = None,
+        replica_budget: int = 0,
     ):
+        if replica_budget:
+            # the replica carries cache per-layer activations of ONE plan's
+            # boundary rows across steps; every mini-batch step runs a
+            # DIFFERENT batch plan (different vertex set, different halo
+            # structure), so a carried replica has no stable identity to
+            # refresh against — same exclusion family as staleness/delta
+            # (analysis/modes.py records the decision; docs/replication.md)
+            raise ValueError(
+                "replica_budget is a full-batch training lever: the "
+                "mini-batch trainer re-plans per batch, so replica carries "
+                "have no stable identity across batch plans — run the "
+                "full-batch trainer for hot-halo replication")
         self.a = sp.csr_matrix(a)
         n = self.a.shape[0]
         self.partvec = np.asarray(partvec, dtype=np.int64)

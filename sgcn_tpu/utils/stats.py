@@ -66,6 +66,20 @@ class CommStats:
     # steps.  Zero until lane_widths is set.
     halo_bytes_true_total: int = 0
     halo_bytes_wire_total: int = 0
+    # Hot-halo replication (``--replica-budget``, docs/replication.md):
+    # replica steps ship the SHRUNKEN no-replica exchange — fewer true rows
+    # (replicated rows leave the volume, not just the pad) AND fewer wire
+    # rows — while refresh (sync) steps ship the full exchange.  One
+    # full-exchange figure, one replica figure, per-step booking; set by
+    # ``set_replica`` (None = no replication, every step full).
+    replica_send_volume_per_exchange: np.ndarray | None = None  # (k,)
+    replica_recv_volume_per_exchange: np.ndarray | None = None  # (k,)
+    replica_send_msgs_per_exchange: np.ndarray | None = None    # (k,)
+    replica_recv_msgs_per_exchange: np.ndarray | None = None    # (k,)
+    replica_wire_rows_per_exchange: int | None = None
+    replica_rows: int = 0                 # plan.replica_rows (gauge only)
+    replica_exchanges: int = 0            # exchanges that rode the shrunken
+    #                                       wire (subset of ``exchanges``)
 
     @classmethod
     def from_plan(cls, plan, schedule: str = "a2a",
@@ -107,50 +121,95 @@ class CommStats:
                                else int(wire_itemsize_bwd)),
         )
 
+    def set_replica(self, plan) -> None:
+        """Record the shrunken no-replica exchange's figures from a plan
+        with the replication layout built (``CommPlan.ensure_replicas``) —
+        ``count_step(replica=True)`` then books replica steps at these.
+        The replica counts are symmetric-exchange figures like the full
+        ones (recv = column sums)."""
+        if plan.nrep_send_counts is None:
+            raise ValueError(
+                "CommStats.set_replica needs the plan's replication layout "
+                "(ensure_replicas)")
+        counts = plan.nrep_send_counts.astype(np.int64)
+        self.replica_send_volume_per_exchange = counts.sum(axis=1)
+        self.replica_recv_volume_per_exchange = counts.sum(axis=0)
+        self.replica_send_msgs_per_exchange = (counts > 0).sum(axis=1)
+        self.replica_recv_msgs_per_exchange = (counts > 0).sum(axis=0)
+        self.replica_wire_rows_per_exchange = int(
+            plan.wire_rows_per_exchange(self.schedule, replica=True))
+        self.replica_rows = int(plan.replica_rows)
+
     def _accumulate_bytes(self, fwd_sweeps: int, bwd_sweeps: int,
-                          fwd_itemsize: int | None = None) -> None:
+                          fwd_itemsize: int | None = None,
+                          replica: bool = False) -> None:
         """Advance the cumulative byte gauges by ``fwd_sweeps`` forward +
         ``bwd_sweeps`` backward exchange SWEEPS (one sweep = one exchange
         per layer, at that layer's lane width — ``lane_widths`` already
         sums over layers), at this step's wire itemsizes (``fwd_itemsize``
         overrides the forward default — the delta-mode sync step's f32
-        re-base)."""
+        re-base).  ``replica=True`` books the step at the SHRUNKEN
+        no-replica volumes (``set_replica``)."""
         if not self.lane_widths:
             return
         fwd = self.wire_itemsize if fwd_itemsize is None else fwd_itemsize
         bwd = (self.wire_itemsize if self.wire_itemsize_bwd is None
                else self.wire_itemsize_bwd)
         lane = sum(self.lane_widths)
-        per_true = int(self.send_volume_per_exchange.sum())
+        if replica:
+            per_true = int(self.replica_send_volume_per_exchange.sum())
+            wire = self.replica_wire_rows_per_exchange
+        else:
+            per_true = int(self.send_volume_per_exchange.sum())
+            wire = self.wire_rows_per_exchange
         factor = lane * (fwd * fwd_sweeps + bwd * bwd_sweeps)
         self.halo_bytes_true_total += per_true * factor
-        self.halo_bytes_wire_total += self.wire_rows_per_exchange * factor
+        self.halo_bytes_wire_total += wire * factor
 
     def count_step(self, nlayers: int, hidden: bool = False,
-                   wire_itemsize: int | None = None) -> None:
+                   wire_itemsize: int | None = None,
+                   replica: bool = False) -> None:
         """One training step = nlayers forward + nlayers backward exchanges
         (the backward halo exchange mirrors the forward —
         ``Parallel-GCN/main.c:340-372``).  ``hidden=True`` marks the step's
         exchanges as latency-hidden (stale pipelined mode).
         ``wire_itemsize`` overrides this step's FORWARD wire itemsize in
-        the cumulative byte gauges (the delta cache's f32 re-base syncs)."""
+        the cumulative byte gauges (the delta cache's f32 re-base syncs).
+        ``replica=True`` books the step's exchanges at the shrunken
+        no-replica volumes (``set_replica`` first) — the replica mode's
+        non-refresh steps."""
+        if replica and self.replica_send_volume_per_exchange is None:
+            raise ValueError(
+                "count_step(replica=True) before set_replica()")
         self.exchanges += 2 * nlayers
         if hidden:
             self.hidden_exchanges += 2 * nlayers
-        self._accumulate_bytes(1, 1, fwd_itemsize=wire_itemsize)
+        if replica:
+            self.replica_exchanges += 2 * nlayers
+        self._accumulate_bytes(1, 1, fwd_itemsize=wire_itemsize,
+                               replica=replica)
 
     def count_forward(self, nlayers: int) -> None:
         self.exchanges += nlayers
         self._accumulate_bytes(1, 0)
 
     def cumulative(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        """Per-rank cumulative (send_vol, send_msgs, recv_vol, recv_msgs)."""
-        return (
-            self.send_volume_per_exchange * self.exchanges,
-            self.send_msgs_per_exchange * self.exchanges,
-            self.recv_volume_per_exchange * self.exchanges,
-            self.recv_msgs_per_exchange * self.exchanges,
-        )
+        """Per-rank cumulative (send_vol, send_msgs, recv_vol, recv_msgs).
+        Replica-booked exchanges (``count_step(replica=True)``) advance at
+        the shrunken per-exchange volumes — replicated rows genuinely left
+        the exchange, so the reference's 8-number line must not claim
+        them."""
+        per = (self.send_volume_per_exchange, self.send_msgs_per_exchange,
+               self.recv_volume_per_exchange, self.recv_msgs_per_exchange)
+        if not self.replica_exchanges:
+            return tuple(p * self.exchanges for p in per)
+        rep = (self.replica_send_volume_per_exchange,
+               self.replica_send_msgs_per_exchange,
+               self.replica_recv_volume_per_exchange,
+               self.replica_recv_msgs_per_exchange)
+        full = self.exchanges - self.replica_exchanges
+        return tuple(p * full + rp * self.replica_exchanges
+                     for p, rp in zip(per, rep))
 
     @staticmethod
     def report_from_cumulative(sv, sm, rv, rm) -> dict:
@@ -176,11 +235,19 @@ class CommStats:
         rep = self.report_from_cumulative(*self.cumulative())
         exposed = self.exchanges - self.hidden_exchanges
         per_ex = int(self.send_volume_per_exchange.sum())
+        rex = self.replica_exchanges
+        per_ex_rep = (int(self.replica_send_volume_per_exchange.sum())
+                      if rex else per_ex)
+        rep_wire = (self.replica_wire_rows_per_exchange
+                    if rex else self.wire_rows_per_exchange)
         rep.update(
             exchanges=self.exchanges,
             exposed_exchanges=exposed,
             hidden_exchanges=self.hidden_exchanges,
-            exposed_send_volume=per_ex * exposed,
+            # replica-booked exchanges are always exposed (the trainer
+            # gates hidden × replica apart), at their shrunken volume
+            exposed_send_volume=(per_ex * (exposed - rex)
+                                 + per_ex_rep * rex),
             hidden_send_volume=per_ex * self.hidden_exchanges,
             # per-schedule padded-vs-true accounting: true rows are what the
             # partitioner optimizes, wire rows what the schedule ships; the
@@ -189,9 +256,22 @@ class CommStats:
             comm_schedule=self.schedule,
             true_rows_per_exchange=per_ex,
             wire_rows_per_exchange=self.wire_rows_per_exchange,
-            wire_rows_total=self.wire_rows_per_exchange * self.exchanges,
+            wire_rows_total=(self.wire_rows_per_exchange
+                             * (self.exchanges - rex) + rep_wire * rex),
             padding_efficiency=self.padding_efficiency,
         )
+        if self.replica_wire_rows_per_exchange is not None:
+            # hot-halo replication gauges (docs/replication.md): the
+            # shrunken exchange's figures next to the full ones, plus how
+            # many exchanges rode it
+            rep.update(
+                replica_exchanges=rex,
+                replica_rows=self.replica_rows,
+                true_rows_per_exchange_replica=int(
+                    self.replica_send_volume_per_exchange.sum()),
+                wire_rows_per_exchange_replica=
+                self.replica_wire_rows_per_exchange,
+            )
         if self.lane_widths:
             # lane-weighted byte gauges: one fwd + one bwd exchange per
             # layer per step, each at that layer's true wire width and its
@@ -230,15 +310,24 @@ class CommStats:
         exchanges = sum(s.exchanges for s in stats_list)
         hidden = sum(s.hidden_exchanges for s in stats_list)
         schedules = {s.schedule for s in stats_list} or {"a2a"}
-        wire_total = sum(s.wire_rows_per_exchange * s.exchanges
-                         for s in stats_list)
+        wire_total = sum(
+            s.wire_rows_per_exchange * (s.exchanges - s.replica_exchanges)
+            + (s.replica_wire_rows_per_exchange or 0) * s.replica_exchanges
+            for s in stats_list)
         rep.update(
             exchanges=exchanges,
             exposed_exchanges=exchanges - hidden,
             hidden_exchanges=hidden,
+            # replica-booked exchanges are exposed at their SHRUNKEN volume
+            # (hidden × replica never co-occur — the trainer gates them
+            # apart), so the merged report keeps the same hidden + exposed
+            # == total reconciliation contract as a single report()
             exposed_send_volume=sum(
                 int(s.send_volume_per_exchange.sum())
-                * (s.exchanges - s.hidden_exchanges) for s in stats_list),
+                * (s.exchanges - s.hidden_exchanges - s.replica_exchanges)
+                + (int(s.replica_send_volume_per_exchange.sum())
+                   if s.replica_exchanges else 0) * s.replica_exchanges
+                for s in stats_list),
             hidden_send_volume=sum(
                 int(s.send_volume_per_exchange.sum()) * s.hidden_exchanges
                 for s in stats_list),

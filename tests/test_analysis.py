@@ -79,6 +79,8 @@ def test_matrix_covers_the_advertised_axes(full_report):
             "serve/gcn/a2a/s0/f32", "serve/gcn/ragged/s0/bf16",
             "serve/gat/a2a/fused", "serve/gat/ragged/fused",
             "minibatch/gcn/ragged/s0/f32",
+            "train/gcn/a2a/s0/f32/rep", "train/gcn/a2a/s0/bf16/rep",
+            "train/gcn/ragged/s0/f32/rep", "train/gcn/ragged/s0/bf16/rep",
             "train/gcn/ragged/s0/f32@banded",
             "train/gcn/ragged/s1/f32@banded"):
         assert required in ids, f"mode {required} missing from the audit"
@@ -90,6 +92,34 @@ def test_stale_modes_audit_both_programs(full_report):
     for mid, entry in full_report["modes"].items():
         if "/s1/" in mid:
             assert set(entry["programs"]) == {"stale", "sync"}, mid
+
+
+def test_replica_modes_audit_both_programs_and_shrink_the_wire(full_report):
+    """Every replica mode lowers BOTH its replica and refresh programs,
+    and the replica program's compiled wire is STRICTLY smaller than the
+    refresh program's (the acceptance contract: replicated rows excluded
+    from the send buckets show up as smaller static wire shapes, via
+    CommPlan.wire_buffer_shapes(replica=True)).  The clean matrix entry
+    already pins the exact shapes; this pins the strict shrink so a
+    degenerate fixture (replicas that shrink nothing) cannot make the
+    rule vacuous."""
+    plan = audit_plan()
+    plan.ensure_ragged()
+    from sgcn_tpu.analysis.hlo_audit import AUDIT_REPLICA_B
+    plan.ensure_replicas(AUDIT_REPLICA_B)
+    assert plan.nrep_s < plan.s
+    assert sum(plan.nrep_rr_sizes) < sum(plan.rr_sizes)
+    for mid, entry in full_report["modes"].items():
+        if mid.endswith("/rep"):
+            assert set(entry["programs"]) == {"rep", "sync"}, mid
+            # same dispatch COUNTS (no round became empty at this budget),
+            # strictly smaller buffers — the shape check inside the census
+            # asserted the exact values already
+            c_rep = entry["programs"]["rep"]["census"]
+            c_sync = entry["programs"]["sync"]["census"]
+            kind = ("collective_permute" if "/ragged/" in mid
+                    else "all_to_all")
+            assert c_rep[kind] > 0 and c_sync[kind] > 0, mid
 
 
 def test_empty_rounds_elided_in_census(full_report):
@@ -170,23 +200,37 @@ def test_train_programs_donate_params_and_state(full_report):
 def test_composition_matrix_matches_doc():
     """The enumerator is the machine face of docs/comm_schedule.md's
     composition matrix — these literals ARE that table's support column
-    (schedule × staleness × delta × model); a drift in either direction
-    fails here."""
+    (schedule × staleness × delta × replicas × model); a drift in either
+    direction fails here."""
     v = train_matrix_verdicts()
     doc_rows = {
-        ("a2a", 0, False, "gcn"): True, ("a2a", 0, False, "gat"): True,
-        ("a2a", 1, False, "gcn"): True, ("a2a", 1, False, "gat"): False,
-        ("a2a", 1, True, "gcn"): True, ("a2a", 1, True, "gat"): False,
-        ("ragged", 0, False, "gcn"): True,
-        ("ragged", 0, False, "gat"): True,
-        ("ragged", 1, False, "gcn"): True,
-        ("ragged", 1, False, "gat"): False,
-        ("ragged", 1, True, "gcn"): True,
-        ("ragged", 1, True, "gat"): False,
+        ("a2a", 0, False, False, "gcn"): True,
+        ("a2a", 0, False, False, "gat"): True,
+        ("a2a", 1, False, False, "gcn"): True,
+        ("a2a", 1, False, False, "gat"): False,
+        ("a2a", 1, True, False, "gcn"): True,
+        ("a2a", 1, True, False, "gat"): False,
+        ("ragged", 0, False, False, "gcn"): True,
+        ("ragged", 0, False, False, "gat"): True,
+        ("ragged", 1, False, False, "gcn"): True,
+        ("ragged", 1, False, False, "gat"): False,
+        ("ragged", 1, True, False, "gcn"): True,
+        ("ragged", 1, True, False, "gat"): False,
         # delta without staleness is a construction-time error everywhere
-        ("a2a", 0, True, "gcn"): False, ("a2a", 0, True, "gat"): False,
-        ("ragged", 0, True, "gcn"): False,
-        ("ragged", 0, True, "gat"): False,
+        ("a2a", 0, True, False, "gcn"): False,
+        ("a2a", 0, True, False, "gat"): False,
+        ("ragged", 0, True, False, "gcn"): False,
+        ("ragged", 0, True, False, "gat"): False,
+        # hot-halo replication: GCN-only, exact transports; composition
+        # with the stale pipeline is deferred (docs/replication.md)
+        ("a2a", 0, False, True, "gcn"): True,
+        ("ragged", 0, False, True, "gcn"): True,
+        ("a2a", 0, False, True, "gat"): False,
+        ("ragged", 0, False, True, "gat"): False,
+        ("a2a", 1, False, True, "gcn"): False,
+        ("ragged", 1, False, True, "gcn"): False,
+        ("a2a", 1, True, True, "gcn"): False,
+        ("ragged", 1, True, True, "gcn"): False,
     }
     for key, supported in doc_rows.items():
         assert v[key][0] is supported, (key, v[key])
@@ -260,6 +304,37 @@ def test_mutation_missing_ragged_round(monkeypatch):
     entry = audit_mode(Mode("train", "gcn", "ragged"))
     assert not entry["ok"]
     assert "collective-census" in _rules_hit(entry)
+
+
+def test_mutation_replica_rows_still_shipped(monkeypatch):
+    """Seeded violation for the replica wire rule: the replica step
+    silently keeps shipping the FULL buckets (replicated rows never leave
+    the wire — numerically indistinguishable because the carry overwrite
+    lands the same rows, so only the compiled wire shapes betray it).
+    The auditor must flag wire-shape on the 'rep' program — the mutation
+    that proves the shrunken-wire expectation is not vacuous."""
+    pspmm = importlib.import_module("sgcn_tpu.ops.pspmm")
+
+    real = pspmm._replica_halo
+
+    def full_wire(x, rep, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+                  rep_slots, axis_name, halo_dtype, fresh):
+        if not fresh:
+            # ship the full exchange, then overwrite replica slots anyway —
+            # same halo table bits, un-shrunken wire
+            halo = pspmm.halo_exchange(x, send_idx, halo_src, axis_name,
+                                       halo_dtype)
+            halo = halo.at[rep_slots].set(rep.astype(halo.dtype),
+                                          mode="drop")
+            return halo, rep
+        return real(x, rep, send_idx, halo_src, nrep_send_idx,
+                    nrep_halo_src, rep_slots, axis_name, halo_dtype, fresh)
+
+    monkeypatch.setattr(pspmm, "_replica_halo", full_wire)
+    entry = audit_mode(Mode("train", "gcn", "a2a", replica=True))
+    assert not entry["ok"]
+    assert not entry["programs"]["rep"]["ok"]
+    assert "wire-shape" in _rules_hit(entry)
 
 
 def test_mutation_host_callback_in_step(monkeypatch):

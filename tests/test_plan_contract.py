@@ -44,8 +44,9 @@ CONSUMER_TUPLES = {
 
 
 def _full_plan():
-    """A k=4 plan with EVERY lazy layout built (cell, pallas tiles, ragged),
-    n ≠ k so a shape coincidence cannot mask a misclassification."""
+    """A k=4 plan with EVERY lazy layout built (cell, pallas tiles, ragged,
+    replicas), n ≠ k so a shape coincidence cannot mask a
+    misclassification."""
     n, k = 200, 4
     ahat = normalize_adjacency(er_graph(n, 6, seed=0))
     pv = balanced_random_partition(n, k, seed=1)
@@ -53,6 +54,7 @@ def _full_plan():
     plan.ensure_cell()
     plan.ensure_pallas_tiles(tb=64)
     plan.ensure_ragged()
+    plan.ensure_replicas(12)
     return plan
 
 

@@ -139,6 +139,70 @@ def test_validator_ragged_stale_ab_contract():
         {"ragged_stale_ab_8dev": {"arms": {"a2a_stale": _rsab_arm(1, 10)}}}))
 
 
+def _replica_cfg(true_total_rep=900, wire_step_rep=80.0, **over):
+    c = {"epoch_s_noreplica": 0.2, "epoch_s_replica": 0.21,
+         "replica_speedup": 0.95, "clean_pairs": 6, "steps": 49,
+         "replica_rows": 64, "replica_send_saving": 500,
+         "true_rows_per_exchange": 3000,
+         "true_rows_per_exchange_replica": 2500,
+         "wire_rows_per_exchange": 4000,
+         "wire_rows_per_exchange_replica": 3600,
+         "halo_bytes_true_total_noreplica": 1000,
+         "halo_bytes_true_total_replica": true_total_rep,
+         "wire_rows_per_step_noreplica": 100.0,
+         "wire_rows_per_step_replica": wire_step_rep}
+    c.update(over)
+    return c
+
+
+def _replica_block(**over):
+    b = {"replica_budget": 64, "sync_every": 4,
+         "random": _replica_cfg(),
+         "hp": _replica_cfg(km1=3000, km1_blind=3010,
+                            km1_cache_aware=2400,
+                            km1_cache_blind_partition=2500),
+         "note": "the wire/true-byte accounting is the asserted figure; "
+                 "CPU-mesh epoch speed is not the claim"}
+    b.update(over)
+    return b
+
+
+def test_validator_replica_ab_contract():
+    """The hot-halo-replication block (PR-10): null needs a degradation
+    marker; shrunken figures may never exceed the full ones; the hp arm
+    must win STRICTLY on true bytes and wire rows/step; the cache-aware
+    km1 must be <= the blind partition's cache objective; and the
+    honest-measurement note is part of the contract."""
+    from validate_bench import check_replica_ab
+
+    assert any("replica_ab_degraded" in e for e in check_replica_ab(
+        {"replica_ab_8dev": None}))
+    assert not check_replica_ab(
+        {"replica_ab_8dev": None, "replica_ab_degraded": "deadline"})
+    assert not check_replica_ab({"replica_ab_8dev": _replica_block()})
+    # a shrunken figure above the full one — a hand-edit tell
+    grew = _replica_block()
+    grew["random"]["true_rows_per_exchange_replica"] = 9999
+    assert any("never grow" in e for e in check_replica_ab(
+        {"replica_ab_8dev": grew}))
+    # non-strict hp win on true bytes — acceptance violated
+    tie = _replica_block()
+    tie["hp"]["halo_bytes_true_total_replica"] = \
+        tie["hp"]["halo_bytes_true_total_noreplica"]
+    assert any("STRICTLY" in e for e in check_replica_ab(
+        {"replica_ab_8dev": tie}))
+    # cache-aware km1 above the blind partition's objective
+    worse = _replica_block()
+    worse["hp"]["km1_cache_aware"] = 2600
+    assert any("km1_cache_aware" in e for e in check_replica_ab(
+        {"replica_ab_8dev": worse}))
+    # B must be positive and the note present
+    assert any("replica_budget" in e for e in check_replica_ab(
+        {"replica_ab_8dev": _replica_block(replica_budget=0)}))
+    assert any("note" in e for e in check_replica_ab(
+        {"replica_ab_8dev": _replica_block(note="timings only")}))
+
+
 def _serve_arm(wire, **over):
     a = {"achieved_qps": 48.0, "latency_p50_ms": 4.0, "latency_p99_ms": 11.0,
          "queries": 200, "compiles": 2, "buckets": [8, 16],
@@ -231,7 +295,7 @@ def test_validator_cli_exit_codes(tmp_path):
     assert "violation" in r.stdout
 
 
-def _clean_analysis_report(n_modes=30):
+def _clean_analysis_report(n_modes=33):
     modes = {
         f"train/gcn/a2a/s0/m{i}": {
             "ok": True,
