@@ -80,6 +80,27 @@ SERVE_COUNTER_KEYS = ("wire_rows_per_query", "wire_rows_per_exchange")
 # are plan- and depth-derived)
 _SERVE_CFG_KEYS = ("n", "graph", "nnz", "nlayers", "k", "offered_qps",
                    "max_batch")
+# hot-halo replication A/B series (PR-10 block, registered PR-12): every
+# one of these is plan-derived and bit-reproducible at fixed config, so
+# they are ZERO-band counters — the measured −11.2% true-rows win is
+# regression-gated per round, not asserted once.  Scoped per partition arm
+# (random/hp — the partitioner axis lives in the series name) and on the
+# block's (n, graph, k, B, sync_every) config.
+REPLICA_COUNTER_KEYS = (
+    "true_rows_per_exchange", "true_rows_per_exchange_replica",
+    "wire_rows_per_exchange", "wire_rows_per_exchange_replica",
+    "wire_rows_per_step_noreplica", "wire_rows_per_step_replica",
+    "km1", "km1_cache_aware", "replica_rows")
+# ONE cfg-key tuple for both replica-family blocks (replica_ab +
+# controller_ab share the scoping axes by construction — the controller
+# child runs the same fixture shape)
+_REPLICA_CFG_KEYS = ("n", "graph", "k", "replica_budget", "sync_every")
+# controller A/B series (PR-12 block): the STATIC arms' exposed wire rows
+# per step are schedule-derived zero-band counters; the controller arm's
+# figure depends on its drift-driven retunes, so it registers REPORT-ONLY
+# (a retune threshold flip across jax versions must not read as a counter
+# regression) — the per-round winner check lives in validate_bench.
+CONTROLLER_COUNTER_KEYS = ("exposed_wire_rows_per_step",)
 # scalar bench-config fields that scope a wall-clock series: a round run at
 # a different problem size / model / dtype is a DIFFERENT measurement, not
 # a regression (graph already keys separately)
@@ -160,6 +181,34 @@ def extract_series(history) -> tuple[dict, list]:
                 if _is_num(parsed.get(ck)):
                     series[("counter", ck) + cfg].append(
                         (rnd, float(parsed[ck])))
+        # hot-halo replication A/B: zero-band plan-derived counters per
+        # partition arm (see REPLICA_COUNTER_KEYS)
+        rb = parsed.get("replica_ab_8dev")
+        if isinstance(rb, dict):
+            rcfg = tuple(rb.get(k) for k in _REPLICA_CFG_KEYS)
+            for part in ("random", "hp"):
+                e = rb.get(part)
+                if not isinstance(e, dict):
+                    continue
+                for ck in REPLICA_COUNTER_KEYS:
+                    if _is_num(e.get(ck)):
+                        series[("counter", f"replica_{part}_{ck}")
+                               + rcfg].append((rnd, float(e[ck])))
+        # controller A/B: static arms zero-band, controller arm report-only
+        cb = parsed.get("controller_ab_8dev")
+        if isinstance(cb, dict) and isinstance(cb.get("arms"), dict):
+            ccfg = tuple(cb.get(k) for k in _REPLICA_CFG_KEYS)
+            for arm, e in cb["arms"].items():
+                if not isinstance(e, dict):
+                    continue
+                for ck in CONTROLLER_COUNTER_KEYS:
+                    if not _is_num(e.get(ck)):
+                        continue
+                    kind = ("metric" if arm == "controller" else "counter")
+                    key = ((kind, f"controller_{arm}_{ck}", "controller",
+                            "rows") + ccfg if kind == "metric"
+                           else (kind, f"controller_{arm}_{ck}") + ccfg)
+                    series[key].append((rnd, float(e[ck])))
         # serving-bench series (see SERVE_* docstrings above): per transport
         # arm, report-only latency/QPS + zero-band wire-row counters
         sv = parsed.get("serve_qps_8dev")
@@ -223,8 +272,18 @@ def _key_name(key: tuple) -> str:
                if c is not None]
         return f"{key[1]} ({key[3]}" \
                + (", " + ", ".join(cfg) if cfg else "") + ")"
+    if key[0] == "metric" and len(key) > 2 and key[2] == "controller":
+        cfg = [f"{k}={c}" for k, c in zip(_REPLICA_CFG_KEYS, key[4:])
+               if c is not None]
+        return f"{key[1]} (report-only" \
+               + (", " + ", ".join(cfg) if cfg else "") + ")"
     if key[0] == "counter" and key[1].startswith("serve_"):
         cfg = [f"{k}={c}" for k, c in zip(_SERVE_CFG_KEYS, key[2:])
+               if c is not None]
+        return f"{key[1]} ({', '.join(cfg)})"
+    if key[0] == "counter" and key[1].startswith(("replica_",
+                                                   "controller_")):
+        cfg = [f"{k}={c}" for k, c in zip(_REPLICA_CFG_KEYS, key[2:])
                if c is not None]
         return f"{key[1]} ({', '.join(cfg)})"
     if key[0] in ("time", "metric"):

@@ -90,6 +90,45 @@ def render(path: str, max_steps: int = 12) -> str:
     if knobs:
         lines.append("  config: "
                      + " ".join(f"{k}={v}" for k, v in knobs.items()))
+    cs = m.get("comm_schedule")
+    if cs:
+        # the transport-selection decision log (resolve_comm_schedule) —
+        # how an 'auto' pick is reconstructible from the run dir alone
+        lines.append(f"  comm schedule: {cs.get('asked')} -> "
+                     f"{cs.get('resolved')} ({cs.get('rule')})")
+        if cs.get("wire_rows_a2a") is not None:
+            lines.append(
+                f"    scored wire rows/exchange: a2a "
+                f"{cs['wire_rows_a2a']}, ragged "
+                f"{cs.get('wire_rows_ragged')} (true {cs.get('true_rows')})")
+        if cs.get("replica_budget"):
+            lines.append(
+                f"    replica-aware (B={cs['replica_budget']}, "
+                f"{cs.get('replica_rows', '?')} rows): shrunken wire "
+                f"a2a {cs.get('wire_rows_a2a_replica', '?')}, ragged "
+                f"{cs.get('wire_rows_ragged_replica', '?')} (true "
+                f"{cs.get('true_rows_replica', '?')})")
+        ra = cs.get("replica_auto")
+        if ra:
+            lines.append(
+                f"    replica budget auto ({ra.get('rule')}): B="
+                f"{ra.get('chosen')} of {ra.get('boundary_rows')} boundary "
+                f"rows, λ·degree score covered "
+                f"{_fmt(ra.get('score_covered'))}")
+        ctl = cs.get("controller")
+        if ctl:
+            lines.append(
+                f"    controller ({ctl.get('kind')}): band "
+                f"{ctl.get('band')}, sync_every "
+                f"{ctl.get('initial_sync_every')} -> "
+                f"{ctl.get('sync_every')}, {len(ctl.get('retunes', []))} "
+                "retune(s)")
+            for d in ctl.get("retunes", []):
+                old, new = (d.get("sync_every") or ["?", "?"])[:2]
+                lines.append(
+                    f"      step {d.get('step')}: drift_rel_max "
+                    f"{_fmt(d.get('drift_rel_max'))} {d.get('rule')} — "
+                    f"sync_every {old} -> {new}")
 
     steps = log.steps()
     if steps:
@@ -177,6 +216,17 @@ def render(path: str, max_steps: int = 12) -> str:
                     lines.append(
                         f"  layer {layer}: ‖replica−fresh‖ at refresh "
                         + _stats(dr) + f", relative {_fmt(rel[-1])} (last)")
+            partials = [r for r in reps if r.get("refresh_kind") == "partial"]
+            if partials:
+                # drift-banded partial refresh (--refresh-band): the
+                # actually-shipped side-channel rows per refresh — the
+                # per-step face of CommStats' partial_refresh_* totals
+                shipped = [sum(r["refresh_rows"]) for r in partials]
+                lines.append(
+                    f"  partial refreshes: {len(partials)}, shipped "
+                    f"rows/refresh " + _stats(shipped)
+                    + f" (side-channel wire rows "
+                    f"{partials[-1].get('refresh_wire_rows')})")
         hdr = (" step      loss  grad_norm    wall_s  exposed  age"
                "  drift_rms(last layer)")
         lines.append("\n" + hdr)

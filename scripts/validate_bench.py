@@ -194,8 +194,76 @@ def check_bench_record(rec: dict) -> list[str]:
             errs += check_ragged_stale_ab(parsed)
         if "replica_ab_8dev" in parsed:
             errs += check_replica_ab(parsed)
+        if "controller_ab_8dev" in parsed:
+            errs += check_controller_ab(parsed)
         if "serve_qps_8dev" in parsed:
             errs += check_serve_qps(parsed)
+    return errs
+
+
+def check_controller_ab(parsed: dict) -> list[str]:
+    """The adaptive-controller A/B contract (PR-12,
+    docs/comm_schedule.md): a ``controller_ab_8dev`` block must carry the
+    controller arm plus all four static arms with positive paired epoch
+    times and a consistent exposed-wire accounting in which the
+    controller's exposed wire rows per step are <= EVERY static arm and
+    STRICTLY below at least one — the controller's acceptance figure
+    (never CPU-mesh epoch time; the honest-measurement ``note`` must say
+    so).  ``null`` needs a ``controller_ab_degraded`` marker."""
+    errs = []
+    block = parsed["controller_ab_8dev"]
+    if block is None:
+        if not isinstance(parsed.get("controller_ab_degraded"), str):
+            errs.append("controller_ab_8dev null without a "
+                        "controller_ab_degraded marker "
+                        "(graceful-degradation contract)")
+        return errs
+    if not isinstance(block, dict):
+        return [f"controller_ab_8dev is {type(block).__name__}, expected "
+                "dict or null"]
+    arms = block.get("arms")
+    if not isinstance(arms, dict):
+        return ["controller_ab_8dev carries no arms dict"]
+    required = ("controller", "a2a_exact", "ragged_exact", "ragged_stale",
+                "replica_stale")
+    missing = [a for a in required if not isinstance(arms.get(a), dict)]
+    if missing:
+        return [f"controller_ab_8dev missing arm(s) {missing}"]
+    for nm in required:
+        e = arms[nm]
+        if not (_is_num(e.get("epoch_s")) and e["epoch_s"] > 0):
+            errs.append(f"controller_ab_8dev.arms.{nm}.epoch_s="
+                        f"{e.get('epoch_s')!r}")
+        if not (_is_num(e.get("exposed_wire_rows_per_step"))
+                and e["exposed_wire_rows_per_step"] >= 0):
+            errs.append(f"controller_ab_8dev.arms.{nm}."
+                        "exposed_wire_rows_per_step="
+                        f"{e.get('exposed_wire_rows_per_step')!r}")
+    if errs:
+        return errs
+    ce = arms["controller"]["exposed_wire_rows_per_step"]
+    statics = [nm for nm in required if nm != "controller"]
+    worse = [nm for nm in statics
+             if ce > arms[nm]["exposed_wire_rows_per_step"]]
+    if worse:
+        errs.append(
+            f"controller_ab_8dev: controller exposed wire rows/step {ce} "
+            f"above static arm(s) {worse} — the controller's acceptance "
+            "inequality")
+    if not any(ce < arms[nm]["exposed_wire_rows_per_step"]
+               for nm in statics):
+        errs.append(
+            f"controller_ab_8dev: controller exposed wire rows/step {ce} "
+            "not STRICTLY below any static arm — a universal tie is not "
+            "a win")
+    cp = block.get("clean_pairs")
+    if not (_is_num(cp) and cp >= 1):
+        errs.append(f"controller_ab_8dev: clean_pairs={cp!r}")
+    note = block.get("note")
+    if not (isinstance(note, str) and "exposed" in note):
+        errs.append("controller_ab_8dev: missing the honest-measurement "
+                    "note naming exposed wire rows as the asserted figure "
+                    "(CPU-mesh epoch speed is not the claim)")
     return errs
 
 
@@ -480,10 +548,11 @@ def check_replica_ab(parsed: dict) -> list[str]:
 
 
 # the supported-matrix floor a committed analysis report may not shrink
-# below (31 mode entries at PR-10 HEAD: PR-9's 27 + the four hot-halo
-# replication modes of the {a2a,ragged} × {f32,bf16} B>0 matrix entry;
-# the matrix only grows)
-ANALYSIS_MIN_MODES = 31
+# below (36 mode entries at PR-12 HEAD: PR-10's 31 + the four composed
+# replica × stale modes of the {a2a,ragged} × {f32,bf16} B>0 staleness-1
+# matrix entry + the banded-fixture composed-ring elision entry; the
+# matrix only grows)
+ANALYSIS_MIN_MODES = 36
 
 
 def check_analysis_report(rec: dict) -> list[str]:

@@ -177,8 +177,11 @@ def train_expectation(trainer, mode, fresh: bool = False) -> Expectation:
     # argument classification (donation): the jit args in flatten order
     groups = [("donate", trainer.params), ("donate", trainer.opt_state)]
     if mode.staleness:
+        # the composed replica × stale mode carries NO replica state of
+        # its own — the stale halo carry subsumes it, so the carry pytree
+        # is exactly the stale mode's
         groups.append(("donate", trainer.halo_carry))
-    if mode.replica:
+    elif mode.replica:
         groups.append(("donate", trainer.replica_carry))
     groups += [("keep", trainer.pa)]
     exp.args = _classify_args(groups)

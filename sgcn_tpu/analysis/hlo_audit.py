@@ -449,7 +449,11 @@ def run_audit(modes=None, fast: bool = False) -> dict:
             "banded fixture lost its empty rounds — the elision census "
             "checks nothing")
         for mode in (Mode("train", "gcn", "ragged"),
-                     Mode("train", "gcn", "ragged", staleness=1)):
+                     Mode("train", "gcn", "ragged", staleness=1),
+                     # the composed replica × stale ring: the SHRUNKEN
+                     # nrep ring's empty rounds must elide too
+                     Mode("train", "gcn", "ragged", staleness=1,
+                          replica=True)):
             entry = audit_mode(mode, plan=banded)
             out["modes"][mode.mode_id + "@banded"] = entry
             out["ok"] = out["ok"] and entry["ok"]

@@ -41,6 +41,10 @@ MODE_FLAGS = {
 NON_AXIS_FLAGS = {
     "--sync-every": "continuous schedule knob — audited via the stale/sync "
                     "program PAIR every stale mode lowers, not as an axis",
+    "--refresh-band": "continuous refresh-policy knob of the replica mode "
+                      "(drift-banded partial refresh) — its program is "
+                      "exercised by tests/test_replica_stale.py; deferred "
+                      "as an audit axis",
 }
 
 GAT_FORMS = ("fused", "split", "packed")
@@ -114,11 +118,10 @@ def is_supported(mode: Mode) -> tuple[bool, str]:
             return False, "gat_form is a GAT axis"
     if m.delta and not m.staleness:
         return False, "halo_delta accumulates into the stale halo carry"
-    if m.replica and m.staleness:
-        return False, ("replica_budget composed with halo_staleness=1 is "
-                       "deferred: the two carry families would share the "
-                       "sync schedule but disagree on what a non-sync "
-                       "exchange ships")
+    if m.replica and m.delta:
+        return False, ("replica_budget composed with halo_delta is "
+                       "deferred: the delta baseline and the replica "
+                       "carry would disagree on what a stale step ships")
     if m.workload in ("serve", "minibatch") and (m.staleness or m.delta
                                                  or m.replica):
         return False, ("staleness/delta/replication are full-batch "

@@ -43,6 +43,12 @@ CONSUMER_TUPLE_SOURCES = {
     "REPLICA_PLAN_FIELDS": "sgcn_tpu.parallel.plan:REPLICA_PLAN_FIELDS",
     "REPLICA_PLAN_FIELDS_RAGGED":
         "sgcn_tpu.parallel.plan:REPLICA_PLAN_FIELDS_RAGGED",
+    "REPLICA_STALE_PLAN_FIELDS":
+        "sgcn_tpu.parallel.plan:REPLICA_STALE_PLAN_FIELDS",
+    "REPLICA_STALE_PLAN_FIELDS_RAGGED":
+        "sgcn_tpu.parallel.plan:REPLICA_STALE_PLAN_FIELDS_RAGGED",
+    "REPLICA_PARTIAL_PLAN_FIELDS":
+        "sgcn_tpu.parallel.plan:REPLICA_PARTIAL_PLAN_FIELDS",
     "SERVE_ROUTER_FIELDS": "sgcn_tpu.serve.router:SERVE_ROUTER_FIELDS",
 }
 

@@ -22,7 +22,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.pspmm import (pspmm_ell_sym, pspmm_overlap, pspmm_ragged_sym,
-                         pspmm_replica, pspmm_replica_ragged, pspmm_stale,
+                         pspmm_replica, pspmm_replica_partial,
+                         pspmm_replica_ragged, pspmm_replica_stale,
+                         pspmm_replica_stale_ragged, pspmm_stale,
                          pspmm_stale_ragged)
 from ..parallel.mesh import AXIS
 from .activations import get_activation
@@ -206,6 +208,13 @@ def gcn_forward_local_stale(
     #                                 composed mode, docs/comm_schedule.md)
     rr_sizes: tuple | None = None,  # static plan.rr_sizes (ragged)
     rr_edge_sizes: tuple | None = None,  # static plan.rr_edge_sizes (ragged)
+    replica: bool = False,          # static: hot-halo replication composed
+    #                                 in (--replica-budget + staleness —
+    #                                 stale steps ship the SHRUNKEN nrep_*
+    #                                 exchange; the carry subsumes the
+    #                                 replica tables)
+    nrep_rr_sizes: tuple | None = None,  # static plan.nrep_rr_sizes
+    #                                      (ragged composed)
     axis_name: str = AXIS,
 ):
     """Per-chip forward under the pipelined stale-halo exchange.
@@ -245,6 +254,16 @@ def gcn_forward_local_stale(
         raise ValueError(
             "composed stale-ragged forward needs the plan's static "
             "rr_sizes + rr_edge_sizes (CommPlan.ensure_ragged)")
+    if replica and delta:
+        raise ValueError(
+            "replica × stale × delta is deferred: the delta baseline and "
+            "the replica carry would disagree on what a stale step ships "
+            "(docs/replication.md)")
+    if replica and comm_schedule == "ragged" and nrep_rr_sizes is None:
+        raise ValueError(
+            "composed replica-stale-ragged forward needs the plan's "
+            "static nrep_rr_sizes (CommPlan.ensure_replicas after "
+            "ensure_ragged)")
     act = get_activation(activation)
     fact = get_activation(final_activation)
     nl = len(params)
@@ -255,7 +274,25 @@ def gcn_forward_local_stale(
         project_first = (w.shape[1] < h.shape[1]
                          and h.shape[1] >= PROJECT_FIRST_MIN_FIN)
         x = (h @ w) if project_first else h
-        if comm_schedule == "ragged":
+        if replica and comm_schedule == "ragged":
+            z, hn, bn = pspmm_replica_stale_ragged(
+                x, halos[i], ghalos[i], bases[i], pa["rsend_idx"],
+                pa["nrep_rsend_idx"], pa["nrep_ring_dst"],
+                pa["ell_idx"], pa["ell_w"],
+                pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
+                pa["redge_dst"], pa["redge_src"], pa["redge_w"],
+                ell_buckets, rr_sizes, rr_edge_sizes, nrep_rr_sizes,
+                axis_name, wire_dtype, gwire_dtype, fresh)
+        elif replica:
+            z, hn, bn = pspmm_replica_stale(
+                x, halos[i], ghalos[i], bases[i],
+                pa["send_idx"], pa["halo_src"],
+                pa["nrep_send_idx"], pa["nrep_halo_src"], pa["rep_slots"],
+                pa["ell_idx"], pa["ell_w"],
+                pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
+                pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
+                ell_buckets, axis_name, wire_dtype, gwire_dtype, fresh)
+        elif comm_schedule == "ragged":
             z, hn, bn = pspmm_stale_ragged(
                 x, halos[i], ghalos[i], bases[i], pa["rsend_idx"],
                 pa["ell_idx"], pa["ell_w"],
@@ -293,7 +330,8 @@ def gcn_forward_local_replica(
     params,
     h,                      # (B, f_in) local feature rows
     pa,                     # plan arrays dict (REPLICA_PLAN_FIELDS /
-    #                         REPLICA_PLAN_FIELDS_RAGGED)
+    #                         REPLICA_PLAN_FIELDS_RAGGED /
+    #                         REPLICA_PARTIAL_PLAN_FIELDS)
     reps,                   # per-layer replica carries: (RP, f_ℓ)
     greps,                  # per-layer gradient-replica carries (same shapes)
     activation: str = "relu",
@@ -308,6 +346,13 @@ def gcn_forward_local_replica(
     rr_edge_sizes: tuple | None = None,  # static plan.rr_edge_sizes (ragged)
     nrep_rr_sizes: tuple | None = None,  # static plan.nrep_rr_sizes (ragged)
     halo_r: int | None = None,           # static plan.r (ragged halo table)
+    rep_base=None,          # per-layer sender-side refresh baselines
+    #                         (RS, f_ℓ) — --refresh-band trainers only
+    track_base: bool = False,       # static: thread the baselines through
+    #                                 (returns (logits, reps, bases, nships))
+    partial_step: bool = False,     # static: THIS program is the partial
+    #                                 refresh step (pspmm_replica_partial)
+    band: float = 0.0,              # static: relative per-row drift band
     axis_name: str = AXIS,
 ):
     """Per-chip forward under hot-halo replication (``--replica-budget``).
@@ -337,17 +382,33 @@ def gcn_forward_local_replica(
             "ragged replica forward needs the plan's static rr_sizes + "
             "rr_edge_sizes + nrep_rr_sizes + halo table height "
             "(CommPlan.ensure_ragged + ensure_replicas)")
+    if partial_step and (not track_base or comm_schedule != "a2a"):
+        raise ValueError(
+            "the partial refresh step needs the threaded baselines "
+            "(track_base=True) and rides the dense a2a transport only "
+            "(docs/replication.md)")
     act = get_activation(activation)
     fact = get_activation(final_activation)
     nl = len(params)
-    new_reps = []
+    new_reps, new_bases, nships = [], [], []
     for i, w in enumerate(params):
         # identical scheduling rule to gcn_forward_local: the carry widths
         # (plan.replica_carry_shapes → exchange_widths) encode the same rule
         project_first = (w.shape[1] < h.shape[1]
                          and h.shape[1] >= PROJECT_FIRST_MIN_FIN)
         x = (h @ w) if project_first else h
-        if comm_schedule == "ragged":
+        if partial_step:
+            z, rn, bn, ns = pspmm_replica_partial(
+                x, reps[i], greps[i], rep_base[i],
+                pa["nrep_send_idx"], pa["nrep_halo_src"], pa["rep_slots"],
+                pa["rep_rows"], pa["rep_row_counts"],
+                pa["ronly_send_idx"], pa["ronly_send_counts"],
+                pa["ronly_base_pos"], pa["rep_recv_src"],
+                pa["ell_idx"], pa["ell_w"],
+                pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
+                pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
+                ell_buckets, axis_name, halo_dtype, band)
+        elif comm_schedule == "ragged":
             z, rn = pspmm_replica_ragged(
                 x, reps[i], greps[i], pa["rsend_idx"],
                 pa["nrep_rsend_idx"], pa["nrep_rhalo_dst"], pa["rep_slots"],
@@ -366,10 +427,36 @@ def gcn_forward_local_replica(
                 pa["ltail_dst"], pa["ltail_src"], pa["ltail_w"],
                 pa["hedge_dst"], pa["hedge_src"], pa["hedge_w"],
                 ell_buckets, axis_name, halo_dtype, fresh)
+        if track_base and not partial_step:
+            if fresh:
+                # full refresh re-anchors the sender-side baseline to what
+                # the CONSUMERS actually received — the wire-quantized
+                # value under --halo-dtype (halo_exchange casts the
+                # refresh's send buffer to the wire dtype and upcasts on
+                # arrival), so sender baseline and every consumer replica
+                # start the next partial-refresh epoch in exact lockstep
+                # (an exact-f32 anchor would carry the quantization error
+                # as permanent sender/receiver disagreement).
+                # lax.stop_gradient: the baselines are carry state, not a
+                # loss path (no cotangent into x)
+                valid = (jnp.arange(pa["rep_rows"].shape[0])
+                         < pa["rep_row_counts"])[:, None].astype(x.dtype)
+                bn = jnp.take(x, pa["rep_rows"], axis=0)
+                if halo_dtype is not None:
+                    bn = bn.astype(halo_dtype).astype(x.dtype)
+                bn = lax.stop_gradient(bn * valid)
+            else:
+                bn = rep_base[i]        # replica steps pass them through
+            ns = jnp.zeros((), jnp.int32)
         if not project_first:
             z = z @ w
         new_reps.append(rn)
+        if track_base:
+            new_bases.append(bn)
+            nships.append(ns)
         h = fact(z) if i == nl - 1 else act(z)
+    if track_base:
+        return h, new_reps, new_bases, nships
     return h, new_reps
 
 

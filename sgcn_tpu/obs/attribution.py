@@ -246,6 +246,46 @@ def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
     )
 
 
+def add_partial_refresh(cost: StepCostModel, refresh_rows,
+                        wire_rows: int, itemsize_fwd: int,
+                        itemsize_bwd: int) -> StepCostModel:
+    """Price one ``--refresh-band`` PARTIAL refresh step: the shrunken
+    replica-step cost (``step_cost(..., replica=True)`` — pass that model
+    in) plus the replica-only side channel at the step's ACTUAL per-layer
+    shipped rows.  The byte arithmetic is the SAME formula
+    ``CommStats.count_partial_refresh_step`` accumulates (value lanes per
+    direction; the gradient side channel's 0/1 indicator adds one
+    f32-equivalent lane to its wire bytes), so the per-step roofline event
+    and the cumulative gauges reconcile exactly.  Returns a new model;
+    the input is not mutated."""
+    from dataclasses import replace
+
+    refresh_rows = [int(x) for x in refresh_rows]
+    if len(refresh_rows) != len(cost.widths):
+        raise ValueError(
+            f"add_partial_refresh: {len(refresh_rows)} per-layer counts "
+            f"for {len(cost.widths)} layers")
+    true_extra = wire_extra = 0
+    per_layer = []
+    for pl, rows, w in zip(cost.per_layer, refresh_rows, cost.widths):
+        t = rows * w * (itemsize_fwd + itemsize_bwd)
+        wi = int(wire_rows) * (w * itemsize_fwd + (w + 1) * itemsize_bwd)
+        true_extra += t
+        wire_extra += wi
+        per_layer.append(dict(pl,
+                              halo_bytes=pl["halo_bytes"] + t // 2,
+                              halo_bytes_true=pl["halo_bytes_true"] + t // 2,
+                              halo_bytes_wire=pl["halo_bytes_wire"]
+                              + wi // 2))
+    return replace(
+        cost,
+        per_layer=per_layer,
+        halo_bytes_per_step=cost.halo_bytes_per_step + true_extra,
+        halo_bytes_true_per_step=cost.halo_bytes_true_per_step + true_extra,
+        halo_bytes_wire_per_step=cost.halo_bytes_wire_per_step + wire_extra,
+    )
+
+
 def roofline_fields(cost: StepCostModel, wall_s: float,
                     exchanges: int = 0, exposed_exchanges: int = 0) -> dict:
     """Join the analytic cost against ONE measured step time.

@@ -171,6 +171,14 @@ DRIFT_KEYS = ("staleness_age", "sync_step", "halo_drift_rms",
 REPLICA_KEYS = ("refresh_age", "sync_step", "replica_rows",
                 "replica_drift_rms", "replica_drift_rel")
 
+# OPTIONAL replica fields, validated when present: drift-banded PARTIAL
+# refresh (--refresh-band, docs/replication.md) stamps refresh steps with
+# ``refresh_kind`` ('full' | 'partial') and, on partial steps, the ACTUAL
+# per-layer side-channel rows shipped (``refresh_rows`` — the per-step
+# face of CommStats' partial_refresh_* cumulative booking) plus the
+# static padded side-channel wire rows (``refresh_wire_rows``).
+REPLICA_REFRESH_KINDS = ("full", "partial")
+
 _MANIFEST_REQUIRED = {"v": _NUM, "ts": _NUM, "run_kind": _STR, "config": dict}
 _MANIFEST_OPTIONAL = {
     "argv": list, "git_rev": (str, type(None)), "backend": dict,
@@ -378,6 +386,26 @@ def validate_event(ev: dict) -> None:
                 raise ValueError(
                     f"replica block: {f} must be a list of finite "
                     f"non-negative per-layer norms, got {v!r}")
+        if "refresh_kind" in rb and \
+                rb["refresh_kind"] not in REPLICA_REFRESH_KINDS:
+            raise ValueError(
+                f"replica block: refresh_kind={rb['refresh_kind']!r} not "
+                f"one of {REPLICA_REFRESH_KINDS}")
+        if rb.get("refresh_kind") == "partial":
+            rr = rb.get("refresh_rows")
+            if not isinstance(rr, list) or any(
+                    not (isinstance(x, _NUM) and not isinstance(x, bool)
+                         and math.isfinite(x) and x >= 0) for x in rr):
+                raise ValueError(
+                    "replica block: a partial refresh must carry "
+                    f"refresh_rows as per-layer non-negative counts, got "
+                    f"{rr!r}")
+            w = rb.get("refresh_wire_rows")
+            if not (isinstance(w, _NUM) and not isinstance(w, bool)
+                    and math.isfinite(w) and w >= 0):
+                raise ValueError(
+                    "replica block: a partial refresh must carry "
+                    f"refresh_wire_rows >= 0, got {w!r}")
 
 
 def validate_manifest(m: dict) -> None:

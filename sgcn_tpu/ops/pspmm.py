@@ -815,6 +815,400 @@ pspmm_replica_ragged.defvjp(_pspmm_replica_ragged_fwd,
                             _pspmm_replica_ragged_bwd)
 
 
+# ------------------------------------------------------ replicas × staleness
+# The COMPOSED mode (``--replica-budget B --halo-staleness 1``): the
+# one-step-stale carry of ``pspmm_stale`` rides the SHRUNKEN no-replica
+# exchange of ``pspmm_replica``.  The stale halo carry SUBSUMES the replica
+# tables — no separate rep/grep carry exists: a stale step ships only the
+# shrunken ``nrep_*`` buffers (with no same-step consumer, so the
+# already-smaller exchange also leaves the critical path) and scatters its
+# receives back into the carried halo table, leaving the replica slots at
+# the values the last sync wrote; a sync step runs the FULL exchange
+# consumed fresh — exactly ``pspmm_stale``'s sync program, so
+# ``--sync-every 1`` is f32-bit-identical to the exact (and no-replica)
+# path.  The ragged flavor carries the ring envelope of
+# ``pspmm_stale_ragged`` and scatters shrunken-round receives into it at
+# ``nrep_ring_dst`` (each kept slot's position in the FULL ring concat).
+# Gradient carries mirror the structure through the ``ghalo_in`` cotangent
+# channel.  Symmetric-Â only, like every composed op here.
+
+
+def _replica_stale_exchange(x, halo_in, send_idx, halo_src, nrep_send_idx,
+                            nrep_halo_src, rep_slots, axis_name, wire_dtype,
+                            fresh):
+    """Issue step t's exchange; return ``halo_next`` (the dense ``(R, f)``
+    carry).  ``fresh``: the full exchange — bit-identical to
+    ``halo_exchange``, every slot (replica slots included) refreshed.
+    Otherwise: the shrunken ``nrep_*`` exchange scattered over the kept
+    slots, replica slots re-seated from the carry (their values propagate
+    sync → sync through the carried table)."""
+    if fresh:
+        return halo_exchange(x, send_idx, halo_src, axis_name, wire_dtype)
+    halo = halo_exchange(x, nrep_send_idx, nrep_halo_src, axis_name,
+                         wire_dtype)
+    rep_vals = jnp.take(halo_in, rep_slots, axis=0, mode="clip")
+    return halo.at[rep_slots].set(rep_vals, mode="drop")
+
+
+def _pspmm_replica_stale_once(x, halo_in, send_idx, halo_src,
+                              nrep_send_idx, nrep_halo_src, rep_slots,
+                              ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+                              hedge_dst, hedge_src, hedge_w,
+                              buckets, axis_name, wire_dtype, fresh):
+    halo_next = _replica_stale_exchange(
+        x, halo_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+        rep_slots, axis_name, wire_dtype, fresh)
+    # stale step: the fold reads the CARRY — the shrunken exchange has no
+    # same-step consumer, so it rides behind compute like pspmm_stale's;
+    # sync step: the fold waits for the full exchange (exact structure)
+    halo_used = halo_next if fresh else halo_in
+    local = spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, x,
+                     buckets)
+    remote = spmm_local(hedge_dst, hedge_src, hedge_w, halo_used,
+                        x.shape[0])
+    return local + remote, halo_next
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(17, 18, 19, 20, 21))
+def pspmm_replica_stale(x, halo_in, ghalo_in, base_in, send_idx, halo_src,
+                        nrep_send_idx, nrep_halo_src, rep_slots,
+                        ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+                        hedge_dst, hedge_src, hedge_w, buckets,
+                        axis_name=AXIS, wire_dtype=None, gwire_dtype=None,
+                        fresh=False):
+    """``PSpMM`` composing hot-halo replication with the one-step-stale
+    carry on the dense a2a (see the section comment above).
+
+    Stale (``fresh=False``) step: the a2a ships the SHRUNKEN ``(k, S')``
+    buckets with no in-step consumer; the consumed halo is the carry, and
+    ``halo_next`` is the carry with the kept slots overwritten by this
+    step's receives (replica slots keep their last-sync values).  Sync
+    (``fresh=True``) step: exactly ``pspmm_stale``'s full-sync program —
+    f32-bit-identical to the exact path.  ``base_in`` passes through
+    untouched (the halo-delta cache does not compose with replication —
+    the trainer gates it); returns ``(out, halo_next, base_next)`` with
+    the same carry arity as ``pspmm_stale`` so the stale forward stays
+    uniform.  The gradient ring mirrors the structure through the
+    ``ghalo_in`` cotangent channel."""
+    out, halo_next = _pspmm_replica_stale_once(
+        x, halo_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+        rep_slots, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+        hedge_dst, hedge_src, hedge_w, buckets, axis_name, wire_dtype,
+        fresh)
+    return out, halo_next, base_in
+
+
+def _pspmm_replica_stale_fwd(x, halo_in, ghalo_in, base_in, send_idx,
+                             halo_src, nrep_send_idx, nrep_halo_src,
+                             rep_slots, ell_idx, ell_w,
+                             ltail_dst, ltail_src, ltail_w,
+                             hedge_dst, hedge_src, hedge_w, buckets,
+                             axis_name, wire_dtype, gwire_dtype, fresh):
+    out, halo_next = _pspmm_replica_stale_once(
+        x, halo_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+        rep_slots, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+        hedge_dst, hedge_src, hedge_w, buckets, axis_name, wire_dtype,
+        fresh)
+    res = (ghalo_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+           rep_slots, ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+           hedge_dst, hedge_src, hedge_w)
+    return (out, halo_next, base_in), res
+
+
+def _pspmm_replica_stale_bwd(buckets, axis_name, wire_dtype, gwire_dtype,
+                             fresh, res, cts):
+    (ghalo_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src, rep_slots,
+     ell_idx, ell_w, ltail_dst, ltail_src, ltail_w,
+     hedge_dst, hedge_src, hedge_w) = res
+    g, _, _ = cts            # carry cotangents are structurally zero
+    # step t's gradient exchange mirrors the forward: shrunken buckets
+    # merged into the carried table on stale steps (no same-step consumer),
+    # the full exchange on syncs — it leaves via the ghalo_in channel
+    gh_next = _replica_stale_exchange(
+        g, ghalo_in, send_idx, halo_src, nrep_send_idx, nrep_halo_src,
+        rep_slots, axis_name, gwire_dtype, fresh)
+    gh_used = gh_next if fresh else ghalo_in
+    gx = (spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, g, buckets)
+          + spmm_local(hedge_dst, hedge_src, hedge_w, gh_used, g.shape[0]))
+    return (gx, None, gh_next, None, *[None] * 13)
+
+
+pspmm_replica_stale.defvjp(_pspmm_replica_stale_fwd,
+                           _pspmm_replica_stale_bwd)
+
+
+def _replica_stale_ring_exchange(x, halo_in, rsend_idx, nrep_rsend_idx,
+                                 nrep_ring_dst, rr_sizes, nrep_rr_sizes,
+                                 axis_name, wire_dtype, fresh):
+    """Issue step t's ring exchange; return the round-major
+    ``(Σ_d S_d, f)`` ring-envelope carry.  ``fresh``: the full per-round
+    ring concat (``_stale_ragged_exchange``'s non-delta path — bit-exact
+    with the exact ragged wire).  Otherwise: the SHRUNKEN ring (live
+    rounds of ``nrep_rr_sizes``) scattered into the carried envelope at
+    each kept slot's full-ring position; replica positions keep their
+    last-sync values."""
+    if fresh:
+        halo_next, _ = _stale_ragged_exchange(
+            x, halo_in, halo_in, rsend_idx, rr_sizes, axis_name, False,
+            wire_dtype, fresh)
+        return halo_next
+    halo_next = halo_in
+    live = ragged_live_rounds(nrep_rr_sizes)
+    off = 0
+    for d, sd in enumerate(nrep_rr_sizes, start=1):
+        if d not in live:
+            off += sd      # keep slice bookkeeping right under ANY rule
+            continue
+        buf = jnp.take(x, nrep_rsend_idx[off: off + sd], axis=0)
+        if wire_dtype is not None:
+            buf = buf.astype(wire_dtype)
+        recv = ppermute_or_identity(buf, axis_name, d).astype(x.dtype)
+        halo_next = halo_next.at[nrep_ring_dst[off: off + sd]].set(
+            recv, mode="drop")
+        off += sd
+    return halo_next
+
+
+def _pspmm_replica_stale_ragged_once(x, halo_in, rsend_idx, nrep_rsend_idx,
+                                     nrep_ring_dst, ell_idx, ell_w,
+                                     ltail_dst, ltail_src, ltail_w,
+                                     redge_dst, redge_src, redge_w,
+                                     buckets, rr_sizes, rr_edge_sizes,
+                                     nrep_rr_sizes, axis_name, wire_dtype,
+                                     fresh):
+    halo_next = _replica_stale_ring_exchange(
+        x, halo_in, rsend_idx, nrep_rsend_idx, nrep_ring_dst, rr_sizes,
+        nrep_rr_sizes, axis_name, wire_dtype, fresh)
+    halo_used = halo_next if fresh else halo_in
+    local = spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, x,
+                     buckets)
+    # the fold always consumes the FULL ring envelope through the exact
+    # redge_* sequence — a sync step therefore reproduces the exact ragged
+    # path's bits, and a stale step folds the carried mixture (kept slots
+    # one step old, replica slots last-sync old)
+    remote = _stale_ragged_fold(halo_used, redge_dst, redge_src, redge_w,
+                                rr_sizes, rr_edge_sizes, x.shape[0])
+    return local + remote, halo_next
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(15, 16, 17, 18, 19, 20, 21, 22))
+def pspmm_replica_stale_ragged(x, halo_in, ghalo_in, base_in, rsend_idx,
+                               nrep_rsend_idx, nrep_ring_dst,
+                               ell_idx, ell_w, ltail_dst, ltail_src,
+                               ltail_w, redge_dst, redge_src, redge_w,
+                               buckets, rr_sizes, rr_edge_sizes,
+                               nrep_rr_sizes, axis_name=AXIS,
+                               wire_dtype=None, gwire_dtype=None,
+                               fresh=False):
+    """``PSpMM`` composing hot-halo replication with the round-structured
+    stale carry on the ragged ring — the replica carry IS a region of the
+    stale ring envelope (``nrep_ring_dst`` maps shrunken receives into the
+    full concat; replica positions are simply never overwritten between
+    syncs).
+
+    Stale step: live rounds of the SHRUNKEN ``nrep_rr_sizes`` ring, no
+    in-step consumer.  Sync step: the full ring consumed fresh —
+    f32-bit-identical to the exact ragged path (``pspmm_stale_ragged``'s
+    contract chains through).  ``base_in`` passes through (no delta
+    composition); same carry arity as ``pspmm_stale_ragged``.
+    Symmetric-Â only."""
+    out, halo_next = _pspmm_replica_stale_ragged_once(
+        x, halo_in, rsend_idx, nrep_rsend_idx, nrep_ring_dst, ell_idx,
+        ell_w, ltail_dst, ltail_src, ltail_w, redge_dst, redge_src,
+        redge_w, buckets, rr_sizes, rr_edge_sizes, nrep_rr_sizes,
+        axis_name, wire_dtype, fresh)
+    return out, halo_next, base_in
+
+
+def _pspmm_replica_stale_ragged_fwd(x, halo_in, ghalo_in, base_in,
+                                    rsend_idx, nrep_rsend_idx,
+                                    nrep_ring_dst, ell_idx, ell_w,
+                                    ltail_dst, ltail_src, ltail_w,
+                                    redge_dst, redge_src, redge_w,
+                                    buckets, rr_sizes, rr_edge_sizes,
+                                    nrep_rr_sizes, axis_name, wire_dtype,
+                                    gwire_dtype, fresh):
+    out, halo_next = _pspmm_replica_stale_ragged_once(
+        x, halo_in, rsend_idx, nrep_rsend_idx, nrep_ring_dst, ell_idx,
+        ell_w, ltail_dst, ltail_src, ltail_w, redge_dst, redge_src,
+        redge_w, buckets, rr_sizes, rr_edge_sizes, nrep_rr_sizes,
+        axis_name, wire_dtype, fresh)
+    res = (ghalo_in, rsend_idx, nrep_rsend_idx, nrep_ring_dst, ell_idx,
+           ell_w, ltail_dst, ltail_src, ltail_w, redge_dst, redge_src,
+           redge_w)
+    return (out, halo_next, base_in), res
+
+
+def _pspmm_replica_stale_ragged_bwd(buckets, rr_sizes, rr_edge_sizes,
+                                    nrep_rr_sizes, axis_name, wire_dtype,
+                                    gwire_dtype, fresh, res, cts):
+    (ghalo_in, rsend_idx, nrep_rsend_idx, nrep_ring_dst, ell_idx, ell_w,
+     ltail_dst, ltail_src, ltail_w, redge_dst, redge_src, redge_w) = res
+    g, _, _ = cts            # carry cotangents are structurally zero
+    gh_next = _replica_stale_ring_exchange(
+        g, ghalo_in, rsend_idx, nrep_rsend_idx, nrep_ring_dst, rr_sizes,
+        nrep_rr_sizes, axis_name, gwire_dtype, fresh)
+    gh_used = gh_next if fresh else ghalo_in
+    gx = (spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, g, buckets)
+          + _stale_ragged_fold(gh_used, redge_dst, redge_src, redge_w,
+                               rr_sizes, rr_edge_sizes, g.shape[0]))
+    return (gx, None, gh_next, None, *[None] * 11)
+
+
+pspmm_replica_stale_ragged.defvjp(_pspmm_replica_stale_ragged_fwd,
+                                  _pspmm_replica_stale_ragged_bwd)
+
+
+# --------------------------------------------------------- partial refresh
+# Drift-driven PARTIAL replica refresh (``--refresh-band``, CaPGNN's cache
+# policy, arXiv:2508.13716): instead of PR-10's all-or-nothing refresh, a
+# refresh step ships ONLY the replica rows whose sender-side drift crosses
+# the band, as a quantized DELTA against the refresh baseline — both ends
+# accumulate the identical increment (the ``_stale_exchange`` lockstep
+# contract), so refreshed rows land in exact sender/receiver agreement and
+# un-refreshed rows ship exact zeros (no change on either end).  The wire
+# is the shrunken replica-step exchange PLUS one replica-only side-channel
+# a2a per direction (``ronly_*`` buckets: exactly the rows
+# ``ensure_replicas`` deleted); the gradient side channel refreshes the
+# gradient replicas for the SAME masked rows with set semantics (one extra
+# 0/1 indicator lane tells the receiver which slots carry fresh values).
+# Dense-a2a transport only — the trainer gates the composition.
+
+
+def _partial_mask(x, base_in, rep_rows, rep_row_count, band):
+    """Sender-side per-row refresh decision: row i refreshes iff
+    ``‖x_i − base_i‖² > band² · ‖base_i‖²`` (relative drift — a zero
+    baseline with any drift always refreshes).  Returns ``(diff, mask,
+    row_valid)`` over the padded (RS, f) owned-replica table."""
+    xr = jnp.take(x, rep_rows, axis=0)                       # (RS, f)
+    row_valid = (jnp.arange(rep_rows.shape[0]) < rep_row_count)
+    diff = (xr - base_in) * row_valid[:, None].astype(x.dtype)
+    drift2 = jnp.sum(jnp.square(diff), axis=-1)
+    ref2 = jnp.sum(jnp.square(base_in), axis=-1)
+    mask = (drift2 > (band * band) * ref2) & row_valid
+    return diff, mask, row_valid
+
+
+def _pspmm_replica_partial_once(x, rep_in, base_in, nrep_send_idx,
+                                nrep_halo_src, rep_slots, rep_rows,
+                                rep_row_count, ronly_send_idx, ronly_counts,
+                                ronly_base_pos, rep_recv_src,
+                                ell_idx, ell_w, ltail_dst, ltail_src,
+                                ltail_w, hedge_dst, hedge_src, hedge_w,
+                                buckets, axis_name, halo_dtype, band):
+    f = x.shape[-1]
+    wdt = x.dtype if halo_dtype is None else jnp.dtype(halo_dtype)
+    halo = halo_exchange(x, nrep_send_idx, nrep_halo_src, axis_name,
+                         halo_dtype)
+    diff, mask, _ = _partial_mask(x, base_in, rep_rows, rep_row_count, band)
+    # the quantized increment, per OWNED replicated row: both ends add THIS
+    # value, so sender baseline and every consumer replica stay in lockstep
+    qinc = (diff * mask[:, None].astype(x.dtype)).astype(wdt).astype(x.dtype)
+    slot_valid = (jnp.arange(ronly_send_idx.shape[-1])[None, :]
+                  < ronly_counts[:, None])                    # (peers, RS')
+    slot_active = (slot_valid
+                   & jnp.take(mask, ronly_base_pos, axis=0))  # masked-in
+    wire = (jnp.take(qinc, ronly_base_pos, axis=0)
+            * slot_valid[..., None].astype(x.dtype)).astype(wdt)
+    recv = a2a_or_identity(wire, axis_name)
+    flat = recv.reshape(-1, f).astype(x.dtype)
+    rep_valid = (rep_slots < halo.shape[0])[:, None].astype(x.dtype)
+    inc = jnp.take(flat, rep_recv_src, axis=0) * rep_valid
+    rep_next = rep_in + inc
+    base_next = base_in + qinc
+    halo = halo.at[rep_slots].set(rep_next.astype(halo.dtype), mode="drop")
+    local = spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, x,
+                     buckets)
+    remote = spmm_local(hedge_dst, hedge_src, hedge_w, halo, x.shape[0])
+    # per-chip count of side-channel slots that carried a fresh row — the
+    # ACTUAL shipped true rows this layer (each consumer copy counts, like
+    # every send-volume gauge); the trainer psums and books it
+    nship = jnp.sum(slot_active.astype(jnp.int32))
+    return local + remote, rep_next, base_next, nship, slot_active
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(21, 22, 23, 24))
+def pspmm_replica_partial(x, rep_in, grep_in, base_in, nrep_send_idx,
+                          nrep_halo_src, rep_slots, rep_rows, rep_row_count,
+                          ronly_send_idx, ronly_counts, ronly_base_pos,
+                          rep_recv_src, ell_idx, ell_w,
+                          ltail_dst, ltail_src, ltail_w,
+                          hedge_dst, hedge_src, hedge_w, buckets,
+                          axis_name=AXIS, halo_dtype=None, band=0.0):
+    """``PSpMM`` with a drift-driven PARTIAL replica refresh (the
+    ``--refresh-band`` refresh step — see the section comment).
+
+    Ships the shrunken replica-step exchange plus the replica-only side
+    channel of masked deltas; consumers see ``rep_next`` (refreshed where
+    shipped, carried otherwise) in their replica halo slots.  The backward
+    mirrors it: the gradient side channel refreshes ``grep`` for the SAME
+    masked rows (fresh values + indicator lane).  Returns ``(out,
+    rep_next, base_next, nship)`` where ``nship`` is this chip's count of
+    side-channel slots that actually carried a row — the booking figure
+    for CommStats/step_cost.  Symmetric-Â, dense-a2a transport only."""
+    out, rep_next, base_next, nship, _ = _pspmm_replica_partial_once(
+        x, rep_in, base_in, nrep_send_idx, nrep_halo_src, rep_slots,
+        rep_rows, rep_row_count, ronly_send_idx, ronly_counts,
+        ronly_base_pos, rep_recv_src, ell_idx, ell_w, ltail_dst, ltail_src,
+        ltail_w, hedge_dst, hedge_src, hedge_w, buckets, axis_name,
+        halo_dtype, band)
+    return out, rep_next, base_next, nship
+
+
+def _pspmm_replica_partial_fwd(x, rep_in, grep_in, base_in, nrep_send_idx,
+                               nrep_halo_src, rep_slots, rep_rows,
+                               rep_row_count, ronly_send_idx, ronly_counts,
+                               ronly_base_pos, rep_recv_src, ell_idx, ell_w,
+                               ltail_dst, ltail_src, ltail_w,
+                               hedge_dst, hedge_src, hedge_w, buckets,
+                               axis_name, halo_dtype, band):
+    out, rep_next, base_next, nship, slot_active = \
+        _pspmm_replica_partial_once(
+            x, rep_in, base_in, nrep_send_idx, nrep_halo_src, rep_slots,
+            rep_rows, rep_row_count, ronly_send_idx, ronly_counts,
+            ronly_base_pos, rep_recv_src, ell_idx, ell_w, ltail_dst,
+            ltail_src, ltail_w, hedge_dst, hedge_src, hedge_w, buckets,
+            axis_name, halo_dtype, band)
+    res = (grep_in, slot_active, nrep_send_idx, nrep_halo_src, rep_slots,
+           rep_rows, ronly_base_pos, rep_recv_src, ell_idx, ell_w,
+           ltail_dst, ltail_src, ltail_w, hedge_dst, hedge_src, hedge_w)
+    return (out, rep_next, base_next, nship), res
+
+
+def _pspmm_replica_partial_bwd(buckets, axis_name, halo_dtype, band, res,
+                               cts):
+    (grep_in, slot_active, nrep_send_idx, nrep_halo_src, rep_slots,
+     rep_rows, ronly_base_pos, rep_recv_src, ell_idx, ell_w,
+     ltail_dst, ltail_src, ltail_w, hedge_dst, hedge_src, hedge_w) = res
+    g, _, _, _ = cts         # carry/count cotangents are structurally zero
+    f = g.shape[-1]
+    wdt = g.dtype if halo_dtype is None else jnp.dtype(halo_dtype)
+    ghalo = halo_exchange(g, nrep_send_idx, nrep_halo_src, axis_name,
+                          halo_dtype)
+    # gradient side channel, SAME mask as the forward: fresh gradient rows
+    # for the masked slots plus one 0/1 indicator lane (set semantics —
+    # the receiver cannot otherwise tell "not refreshed" from a zero row)
+    grows = jnp.take(g, rep_rows, axis=0)                     # (RS, f)
+    act = slot_active.astype(g.dtype)[..., None]              # (peers,RS',1)
+    gsel = jnp.take(grows, ronly_base_pos, axis=0) * act
+    gwire = jnp.concatenate([gsel, act], axis=-1).astype(wdt)
+    grecv = a2a_or_identity(gwire, axis_name)
+    gflat = grecv.reshape(-1, f + 1).astype(g.dtype)
+    vals = jnp.take(gflat, rep_recv_src, axis=0)
+    rep_valid = (rep_slots < ghalo.shape[0])[:, None].astype(g.dtype)
+    refreshed = vals[:, f:] * rep_valid                       # (RP, 1)
+    grep_next = grep_in * (1.0 - refreshed) + vals[:, :f] * refreshed
+    gtab = ghalo.at[rep_slots].set(grep_next.astype(ghalo.dtype),
+                                   mode="drop")
+    gx = (spmm_ell(ell_idx, ell_w, ltail_dst, ltail_src, ltail_w, g, buckets)
+          + spmm_local(hedge_dst, hedge_src, hedge_w, gtab, g.shape[0]))
+    return (gx, None, grep_next, None, *[None] * 17)
+
+
+pspmm_replica_partial.defvjp(_pspmm_replica_partial_fwd,
+                             _pspmm_replica_partial_bwd)
+
+
 # --------------------------------------------------------------------- stale
 # Pipelined one-step-stale exchange (PipeGCN-style, arXiv:2203.10428): layer ℓ
 # of step t aggregates with the halo received during step t−1, and step t's

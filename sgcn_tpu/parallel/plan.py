@@ -60,7 +60,9 @@ PER_CHIP_ARRAY_FIELDS = (
     "rsend_idx", "rhalo_dst", "redge_dst", "redge_src", "redge_w",
     "nrep_send_idx", "nrep_send_counts", "nrep_halo_src",
     "rep_slots", "rep_counts", "nrep_rsend_idx", "nrep_rhalo_dst",
-    "rep_ring_pos",
+    "rep_ring_pos", "nrep_ring_dst",
+    "rep_rows", "rep_row_counts", "ronly_send_idx", "ronly_send_counts",
+    "ronly_base_pos", "rep_recv_src",
 )
 
 # Auto-selection threshold for SGCN_COMM_SCHEDULE=auto: below this dense-a2a
@@ -107,6 +109,46 @@ REPLICA_PLAN_FIELDS_RAGGED = (
     "ell_idx", "ell_w", "ltail_dst", "ltail_src", "ltail_w",
     "hedge_dst", "hedge_src", "hedge_w",
     "redge_dst", "redge_src", "redge_w",
+)
+
+# Plan arrays the COMPOSED replica × stale step ships
+# (``ops.pspmm.pspmm_replica_stale`` / ``pspmm_replica_stale_ragged``,
+# docs/comm_schedule.md): the stale halo carry subsumes the replica tables
+# (replica slots/positions propagate through it between syncs), so unlike
+# the pure replica mode there is no separate rep/grep carry — the shipped
+# fields are the full exchange layout (sync steps) plus the SHRUNKEN
+# no-replica layout (stale steps, which scatter their receives back into
+# the carried table).  The a2a tuple currently EQUALS ``REPLICA_PLAN_FIELDS``
+# — kept as its own contract tuple anyway (the STALE_PLAN_FIELDS_RAGGED
+# precedent): the pure-replica step ships per-slot rep gathers the
+# composed mode may drop, so the two evolve for different reasons.  The
+# ragged flavor rides the ring-envelope carry of ``pspmm_stale_ragged``:
+# ``nrep_ring_dst`` maps each shrunken receive slot to its position in
+# the FULL ring's round-major concat.
+REPLICA_STALE_PLAN_FIELDS = (
+    "send_idx", "halo_src",
+    "nrep_send_idx", "nrep_halo_src", "rep_slots",
+    "ell_idx", "ell_w", "ltail_dst", "ltail_src", "ltail_w",
+    "hedge_dst", "hedge_src", "hedge_w",
+)
+REPLICA_STALE_PLAN_FIELDS_RAGGED = (
+    "rsend_idx", "nrep_rsend_idx", "nrep_ring_dst",
+    "ell_idx", "ell_w", "ltail_dst", "ltail_src", "ltail_w",
+    "redge_dst", "redge_src", "redge_w",
+)
+
+# Plan arrays the PARTIAL refresh step ships (``--refresh-band``,
+# ``ops.pspmm.pspmm_replica_partial``, docs/replication.md): the shrunken
+# replica-step layout plus the replica-only side channel — the owned
+# replicated rows and their sender-side baseline positions
+# (``rep_rows``/``ronly_base_pos``), the replica-only per-pair buckets
+# (``ronly_*``: exactly the rows ``ensure_replicas`` deleted from the
+# ``nrep_*`` layout), and the receive routing of refreshed rows into the
+# carried replica table (``rep_recv_src``).
+REPLICA_PARTIAL_PLAN_FIELDS = REPLICA_PLAN_FIELDS + (
+    "rep_rows", "rep_row_counts",
+    "ronly_send_idx", "ronly_send_counts", "ronly_base_pos",
+    "rep_recv_src",
 )
 
 
@@ -275,6 +317,27 @@ class CommPlan:
     nrep_rhalo_dst: np.ndarray | None = None    # (k, ΣS'_d) int32; r = pad
     rep_ring_pos: np.ndarray | None = None      # (k, RP) int32 into the full
     #                                             (ΣS_d) ring concat
+    nrep_ring_dst: np.ndarray | None = None     # (k, ΣS'_d) int32: each
+    #                                             shrunken receive slot's
+    #                                             position in the FULL ring
+    #                                             concat (ΣS_d = pad, dropped)
+    #                                             — the composed replica ×
+    #                                             stale carry scatter map
+    # Partial-refresh side channel (``--refresh-band``): the SENDER's view
+    # of its own replicated rows (local ids + per-pair replica-only buckets
+    # = exactly the rows deleted from ``nrep_*``) and the RECEIVER's routing
+    # of refreshed rows into the carried replica table.
+    rs: int | None = None                       # padded owned-replicated rows
+    rep_rows: np.ndarray | None = None          # (k, RS) int32 local row ids
+    rep_row_counts: np.ndarray | None = None    # (k,) int32 true counts
+    ronly_s: int | None = None                  # replica-only bucket pad
+    ronly_send_idx: np.ndarray | None = None    # (k, k, RS') int32 local rows
+    ronly_send_counts: np.ndarray | None = None  # (k, k) int32
+    ronly_base_pos: np.ndarray | None = None    # (k, k, RS') int32 into
+    #                                             rep_rows (baseline row)
+    rep_recv_src: np.ndarray | None = None      # (k, RP) int32 flat
+    #                                             (o·RS' + pos) receive index
+    #                                             per carried replica slot
 
     # identities of the chips this (possibly sliced) plan's rows describe —
     # set by the shard proxy (``parallel/proxy.py``) so the comm-stat
@@ -621,6 +684,34 @@ class CommPlan:
         nrep_send_idx = np.zeros((k, k, nrep_s), np.int32)
         for (p, q), kept in kept_lists.items():
             nrep_send_idx[p, q, : len(kept)] = self.send_idx[p, q, kept]
+        # partial-refresh side channel (``--refresh-band``): the sender's
+        # owned replicated rows (drift is measured against a baseline per
+        # OWNED row, not per consumer copy) and the replica-only per-pair
+        # buckets — exactly the complement of the kept lists above, order
+        # preserved so the receive side stays aligned by construction
+        rows_lists = [np.nonzero(rep_mask[p])[0] for p in range(k)]
+        rs = max(1, max((len(x) for x in rows_lists), default=0))
+        rep_rows = np.zeros((k, rs), np.int32)
+        rep_row_counts = np.zeros(k, np.int32)
+        for p in range(k):
+            rep_rows[p, : len(rows_lists[p])] = rows_lists[p]
+            rep_row_counts[p] = len(rows_lists[p])
+        ronly_counts = (sc.astype(np.int32) - nrep_counts)
+        ronly_s = max(1, int(ronly_counts.max()) if k else 1)
+        ronly_send_idx = np.zeros((k, k, ronly_s), np.int32)
+        ronly_base_pos = np.zeros((k, k, ronly_s), np.int32)
+        for p in range(k):
+            for q in range(k):
+                cnt = int(sc[p, q])
+                if not cnt:
+                    continue
+                rows_pq = self.send_idx[p, q, :cnt]
+                deleted = np.nonzero(rep_mask[p, rows_pq])[0]
+                if not len(deleted):
+                    continue
+                ronly_send_idx[p, q, : len(deleted)] = rows_pq[deleted]
+                ronly_base_pos[p, q, : len(deleted)] = np.searchsorted(
+                    rows_lists[p], rows_pq[deleted]).astype(np.int32)
         # receive side: shrunken halo gather + replica slot lists.  Ring
         # positions: round d's receive slice starts at Σ_{d'<d} S_d' and a
         # slot's within-round position is its send-list position j
@@ -628,12 +719,13 @@ class CommPlan:
         offsets = (np.concatenate([[0], np.cumsum(self.rr_sizes)])
                    if ring else None)
         nrep_halo_src = np.zeros((k, r), np.int32)
-        rep_slot_lists, rep_ring_lists = [], []
+        rep_slot_lists, rep_ring_lists, rep_recv_lists = [], [], []
         for q in range(k):
             hs = int(self.halo_counts[q])
             if not hs:
                 rep_slot_lists.append(np.zeros(0, np.int64))
                 rep_ring_lists.append(np.zeros(0, np.int64))
+                rep_recv_lists.append(np.zeros(0, np.int64))
                 continue
             slots = np.asarray(self.halo_src[q, :hs])
             o = slots // s
@@ -641,13 +733,19 @@ class CommPlan:
             rows = self.send_idx[o, q, j]
             keep = ~rep_mask[o, rows]
             newpos = np.zeros(hs, np.int64)
+            npos_del = np.zeros(hs, np.int64)
             for oo in np.unique(o):
                 m = o == oo
                 newpos[m] = np.cumsum(keep[m]) - 1
+                npos_del[m] = np.cumsum(~keep[m]) - 1
             nrep_halo_src[q, :hs] = np.where(
                 keep, o * nrep_s + newpos, 0).astype(np.int32)
             reps = np.nonzero(~keep)[0]
             rep_slot_lists.append(reps)
+            # partial refresh routes each carried replica slot to its row's
+            # position in the replica-only receive buffer (same ordering as
+            # the ronly send buckets — deleted rows keep send-list order)
+            rep_recv_lists.append(o[reps] * ronly_s + npos_del[reps])
             if ring:
                 d = (q - o) % k
                 rep_ring_lists.append(offsets[d[reps] - 1] + j[reps])
@@ -656,8 +754,10 @@ class CommPlan:
         rp = max(1, max((len(x) for x in rep_slot_lists), default=0))
         rep_slots = np.full((k, rp), r, np.int32)
         rep_ring_pos = np.zeros((k, rp), np.int32)
+        rep_recv_src = np.zeros((k, rp), np.int32)
         for q in range(k):
             rep_slots[q, : len(rep_slot_lists[q])] = rep_slot_lists[q]
+            rep_recv_src[q, : len(rep_recv_lists[q])] = rep_recv_lists[q]
             if ring:
                 rep_ring_pos[q, : len(rep_ring_lists[q])] = \
                     rep_ring_lists[q]
@@ -670,13 +770,25 @@ class CommPlan:
         self.nrep_send_counts = nrep_counts
         self.nrep_halo_src = nrep_halo_src
         self.rep_ring_pos = rep_ring_pos if ring else None
+        self.rs = rs
+        self.rep_rows = rep_rows
+        self.rep_row_counts = rep_row_counts
+        self.ronly_s = ronly_s
+        self.ronly_send_idx = ronly_send_idx
+        self.ronly_send_counts = ronly_counts
+        self.ronly_base_pos = ronly_base_pos
+        self.rep_recv_src = rep_recv_src
         if ring:
             idxk = np.arange(k)
             nrr = tuple(int(nrep_counts[idxk, (idxk + d) % k].max())
                         for d in range(1, k))
             st = max(1, sum(nrr))
+            full_total = int(sum(self.rr_sizes))
             nrep_rsend_idx = np.zeros((k, st), np.int32)
             nrep_rhalo_dst = np.full((k, st), r, np.int32)
+            # pad slots point one past the full ring concat — dropped by the
+            # composed replica × stale carry scatter (mode='drop')
+            nrep_ring_dst = np.full((k, st), full_total, np.int32)
             off = 0
             for d, sd in enumerate(nrr, start=1):
                 for p in range(k):
@@ -701,19 +813,31 @@ class CommPlan:
                                 f"list says {rc}")
                         nrep_rhalo_dst[p, off: off + rc] = \
                             ranks.astype(np.int32)
+                        # each kept receive slot's home in the FULL ring
+                        # concat: its round offset + full send-list position
+                        # (the ring receive invariant of ensure_ragged)
+                        nrep_ring_dst[p, off: off + rc] = (
+                            offsets[d - 1]
+                            + (slots % s)[ranks]).astype(np.int32)
                 off += sd
             self.nrep_rr_sizes = nrr
             self.nrep_rsend_idx = nrep_rsend_idx
             self.nrep_rhalo_dst = nrep_rhalo_dst
+            self.nrep_ring_dst = nrep_ring_dst
         self.replica_budget = int(budget)
         return self
 
-    def replica_carry_shapes(self, fin: int, widths) -> dict:
+    def replica_carry_shapes(self, fin: int, widths,
+                             partial: bool = False) -> dict:
         """Per-layer replica-carry shapes (WITHOUT the stacked leading k
         axis): one ``(RP, f_ℓ)`` feature-replica table and one gradient-
         replica table per layer, at the layer's EXCHANGED width
         (``models.gcn.exchange_widths`` — same lockstep rule as the stale
-        carries).  Requires ``ensure_replicas()`` first."""
+        carries).  ``partial=True`` (``--refresh-band``) adds the per-layer
+        SENDER-side refresh baselines ``rep_base[ℓ]`` — one ``(RS, f_ℓ)``
+        table of each chip's own replicated rows as of the last refresh,
+        the reference the per-row drift band is measured against.
+        Requires ``ensure_replicas()`` first."""
         from ..models.gcn import exchange_widths   # deferred: avoids a cycle
 
         if self.rep_slots is None:
@@ -721,10 +845,25 @@ class CommPlan:
                 "replica carries need the replication layout; call "
                 "ensure_replicas() before replica_carry_shapes()")
         fs = exchange_widths(fin, list(widths))
-        return {
+        out = {
             "reps": [(self.rp, f) for f in fs],
             "greps": [(self.rp, f) for f in fs],
         }
+        if partial:
+            out["rep_base"] = [(self.rs, f) for f in fs]
+        return out
+
+    @property
+    def partial_refresh_wire_rows(self) -> int:
+        """Padded wire rows of ONE partial-refresh side-channel exchange
+        (the replica-only a2a of ``--refresh-band`` refresh steps): the
+        dense ``(k, RS')`` bucket per chip, on top of the shrunken
+        ``nrep_*`` exchange those steps also ship."""
+        if self.ronly_send_counts is None:
+            raise ValueError("build the replication layout first "
+                             "(ensure_replicas)")
+        rows, peers = np.asarray(self.ronly_send_counts).shape
+        return int(rows * peers * self.ronly_s)
 
     @property
     def replica_send_volume(self) -> np.ndarray:
@@ -842,10 +981,44 @@ class CommPlan:
         return np.asarray(blocks)[self.owner, self.local_idx]
 
 
+def choose_replica_budget(plan, decision: dict | None = None) -> int:
+    """Auto-tune the replica budget B from the plan's λ·degree curve — the
+    ``--replica-budget auto`` rule.
+
+    Ranks every boundary row by its replica score λ·edges
+    (``replica_scores``, the quantity ``ensure_replicas`` selects on),
+    then picks the KNEE of the descending score curve: the prefix length
+    at which the normalized cumulative score sits farthest above the
+    diagonal (max-gap elbow — deterministic, scale-free, and exactly the
+    "few hub rows own most of the exchange" shape of a power-law
+    boundary).  A flat curve (every boundary row equally hot) has its max
+    gap at ~0 and picks a small B rather than replicating everything.
+    Returns the chosen B; ``decision`` (filled in place) records the
+    scoring inputs so the pick is reconstructible from the run manifest
+    (``comm_schedule.replica_auto`` block)."""
+    lam, cons = plan.replica_scores()
+    score = (lam.astype(np.float64) * cons).ravel()
+    boundary = np.sort(score[lam.ravel() > 0])[::-1]
+    log = decision if decision is not None else {}
+    m = int(len(boundary))
+    log.update(rule="lambda-degree-knee", boundary_rows=m)
+    if m == 0 or boundary[0] <= 0:
+        log.update(chosen=0, score_covered=0.0)
+        return 0
+    cum = np.cumsum(boundary)
+    gap = cum / cum[-1] - np.arange(1, m + 1) / m
+    b = int(np.argmax(gap)) + 1
+    log.update(chosen=b, score_total=float(cum[-1]),
+               score_covered=float(cum[b - 1] / cum[-1]),
+               knee_gap=float(gap[b - 1]))
+    return b
+
+
 def resolve_comm_schedule(schedule: str | None, plans, model: str,
                           halo_staleness: int = 0,
                           fin: int | None = None, widths=None,
                           compute_dtype: str | None = None,
+                          replica_budget: int = 0,
                           decision: dict | None = None) -> str:
     """Resolve a ``comm_schedule`` knob to a concrete transport — THE one
     selection rule shared by both trainers (a second copy would drift).
@@ -883,6 +1056,14 @@ def resolve_comm_schedule(schedule: str | None, plans, model: str,
     attribution/CommStats byte gauges.  ``compute_dtype`` is accepted for
     signature stability with those byte models; it cannot change the ratio.
 
+    ``replica_budget`` (B > 0, already resolved from ``auto`` by the
+    caller): score the wire rows WITH the replica shrink — a
+    ``--replica-budget`` run ships the shrunken ``nrep_*`` exchange on
+    every non-refresh step, so comparing the transports on the FULL pads
+    would score a wire the run never pays.  Builds the ragged + replica
+    layouts on each plan as a side effect (both are lazy and idempotent;
+    ``resolve_forward_setup`` would build them right after anyway).
+
     ``decision`` (optional dict, filled in place): the selection's inputs
     and the rule that fired — the trainers stash it and ``attach_recorder``
     logs it into the run manifest (``comm_schedule`` block), so an ``auto``
@@ -899,7 +1080,8 @@ def resolve_comm_schedule(schedule: str | None, plans, model: str,
         raise ValueError(
             f"comm_schedule must be 'a2a', 'ragged' or 'auto', got "
             f"{schedule!r}")
-    log.update(asked=asked, model=model, halo_staleness=int(halo_staleness))
+    log.update(asked=asked, model=model, halo_staleness=int(halo_staleness),
+               replica_budget=int(replica_budget))
 
     def resolved(value: str, rule: str) -> str:
         log.update(resolved=value, rule=rule)
@@ -917,12 +1099,28 @@ def resolve_comm_schedule(schedule: str | None, plans, model: str,
         if not (p.symmetric and ragged_ready and sc.shape[1] > 1):
             return resolved("a2a", "plan does not support the ragged ring "
                                    "(asymmetric, sliced, or k == 1)")
+        if replica_budget:
+            # replica-aware scoring: the steady-state step ships the
+            # SHRUNKEN exchange, so the transports are compared at the
+            # shrunken pads (the full figures are logged alongside)
+            p.ensure_ragged()
+            p.ensure_replicas(replica_budget)
         true += int(sc.sum())
         wire += p.wire_rows_per_exchange("a2a")
         wire_ragged += p.wire_rows_per_exchange("ragged")
     log.update(true_rows=true, wire_rows_a2a=wire,
-               wire_rows_ragged=wire_ragged,
-               padding_efficiency=(true / wire if wire else 1.0),
+               wire_rows_ragged=wire_ragged)
+    if replica_budget:
+        true = sum(int(np.asarray(p.nrep_send_counts).sum()) for p in plans)
+        wire = sum(p.wire_rows_per_exchange("a2a", replica=True)
+                   for p in plans)
+        wire_ragged = sum(p.wire_rows_per_exchange("ragged", replica=True)
+                          for p in plans)
+        log.update(replica_rows=sum(int(p.replica_rows) for p in plans),
+                   true_rows_replica=true,
+                   wire_rows_a2a_replica=wire,
+                   wire_rows_ragged_replica=wire_ragged)
+    log.update(padding_efficiency=(true / wire if wire else 1.0),
                threshold=RAGGED_AUTO_EFFICIENCY)
     if halo_staleness:
         # hidden exchange: bytes-only rule (see docstring)
@@ -933,7 +1131,11 @@ def resolve_comm_schedule(schedule: str | None, plans, model: str,
                                "ships no fewer wire rows")
     if not wire or true / wire >= RAGGED_AUTO_EFFICIENCY:
         return resolved("a2a", "padding efficiency at/above threshold")
-    if model == "gcn" and fin is not None and widths is not None:
+    if (model == "gcn" and fin is not None and widths is not None
+            and not replica_budget):
+        # replica runs never select the Pallas aggregator (the replica
+        # carry contract is built around the ELL + hedge fold), so there
+        # is no VMEM kernel to forfeit on that path
         from ..ops.pallas_spmm import use_pallas_spmm   # deferred: jax
         if use_pallas_spmm(plans[0], fin, widths):
             return resolved("a2a", "Pallas VMEM aggregator would be "

@@ -27,6 +27,14 @@ import json
 import sys
 
 
+def _budget(text: str):
+    """``--replica-budget`` values: a non-negative int or ``auto`` (the
+    λ·degree-knee rule, ``parallel/plan.py::choose_replica_budget``)."""
+    if text == "auto":
+        return "auto"
+    return int(text)
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description="sgcn_tpu distributed trainer")
     p.add_argument("-a", "--adjacency", default=None,
@@ -73,7 +81,8 @@ def main() -> None:
                         "drift; replica mode: refresh the replica tables "
                         "every N steps; 0 = only the initializing first "
                         "step")
-    p.add_argument("--replica-budget", type=int, default=0,
+    p.add_argument("--replica-budget", type=_budget, default=0,
+                   metavar="B|auto",
                    help="hot-halo replication (docs/replication.md): "
                         "promote the top-B boundary rows (by λ·degree from "
                         "the comm plan) to persistent replicas on their "
@@ -82,8 +91,21 @@ def main() -> None:
                         "steps (at --sync-every 1 the trajectory is f32-"
                         "bit-identical to the no-replica path); full-batch "
                         "GCN, symmetric adjacency, f32; composes with "
-                        "--comm-schedule a2a/ragged and --halo-dtype; "
-                        "0 = off")
+                        "--comm-schedule a2a/ragged, --halo-dtype AND "
+                        "--halo-staleness 1 (the composed mode: stale "
+                        "steps hide the already-shrunken exchange); "
+                        "'auto' picks B at the knee of the plan's "
+                        "λ·degree curve (the pick lands in the manifest "
+                        "comm_schedule block); 0 = off")
+    p.add_argument("--refresh-band", type=float, default=None, metavar="RHO",
+                   help="drift-driven PARTIAL replica refresh "
+                        "(docs/replication.md): scheduled refresh steps "
+                        "ship only the replica rows whose relative drift "
+                        "‖x−base‖/‖base‖ exceeds RHO, as deltas against "
+                        "the refresh baseline (CaPGNN-style) — booked at "
+                        "the actual shipped rows; requires "
+                        "--replica-budget > 0, --comm-schedule a2a, no "
+                        "staleness; step 0 always refreshes in full")
     p.add_argument("--comm-schedule", default=None,
                    choices=["a2a", "ragged", "auto"],
                    help="halo transport (docs/comm_schedule.md): a2a = "
@@ -177,15 +199,25 @@ def main() -> None:
                                 or args.model != "gcn"
                                 or args.experiment == "accuracy"
                                 or args.dtype
-                                or args.halo_staleness):
+                                or args.halo_delta):
         raise SystemExit(
             "--replica-budget replicates rows of the full-batch GCN "
             "exchange only (the mini-batch trainer re-plans per batch, so "
             "replica carries have no stable identity across batch plans; "
             "GAT ships per-layer attention tables; the accuracy-parity "
             "harness is defined for the exact exchange; the carries are "
-            "f32 state; composition with --halo-staleness 1 is deferred — "
-            "drop the conflicting flag)")
+            "f32 state; composition with --halo-delta is deferred — the "
+            "delta baseline and the replica carry would disagree on what "
+            "a stale step ships — drop the conflicting flag)")
+    if args.refresh_band is not None and (not args.replica_budget
+                                          or args.halo_staleness
+                                          or args.comm_schedule == "ragged"):
+        raise SystemExit(
+            "--refresh-band schedules the drift-driven PARTIAL replica "
+            "refresh: it requires --replica-budget > 0, rides the dense "
+            "a2a transport, and does not compose with --halo-staleness 1 "
+            "(the composed mode's replica state lives inside the stale "
+            "carry) — drop the conflicting flag")
     # --comm-schedule ragged composes with --halo-staleness 1 since the
     # round-structured stale carry (pspmm_stale_ragged); the remaining
     # genuinely unsupported combo is the accuracy-parity harness, which is
@@ -346,7 +378,8 @@ def main() -> None:
                                   halo_delta=args.halo_delta,
                                   sync_every=args.sync_every,
                                   comm_schedule=args.comm_schedule,
-                                  replica_budget=args.replica_budget)
+                                  replica_budget=args.replica_budget,
+                                  refresh_band=args.refresh_band)
             if recorder is not None:
                 recorder.set_plan(plan, partitioner={"partvec": args.partvec,
                                                      "k": k})

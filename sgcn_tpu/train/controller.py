@@ -1,0 +1,96 @@
+"""Adaptive communication controller — the runtime half of
+``--comm-schedule auto`` (docs/comm_schedule.md).
+
+``resolve_comm_schedule`` closes the PLAN-time loop (transport choice,
+replica-aware wire scoring, the ``--replica-budget auto`` λ·degree knee);
+this module closes the RUN-time loop: the trainers measure per-layer
+drift at every sync/refresh step (the stale mode's ‖stale − fresh‖ and
+the replica mode's ‖replica − fresh‖ relative RMS — the PR-3/PR-10 drift
+gauges), and the controller retunes the EFFECTIVE ``--sync-every``
+against a hysteresis band:
+
+  * measured relative drift above ``upper`` → the carries are going stale
+    faster than the sync schedule bounds — HALVE the sync interval (more
+    frequent exact steps, floored at ``min_sync``);
+  * below ``lower`` → the schedule is syncing for drift that is not
+    there — DOUBLE the interval (fewer exposed full exchanges, capped at
+    ``max_sync``; widening is what the composed modes convert directly
+    into fewer exposed wire rows per step);
+  * in between → hold.
+
+Decisions are deterministic in the gauge sequence (no wall-clock, no
+randomness — the band-crossing retune test drives ``observe`` with
+injected gauges) and every decision is logged with its inputs; the
+trainer writes the log into the run manifest's ``comm_schedule`` block
+(``controller`` key), rendered by ``scripts/obs_report.py``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# default hysteresis band on the max-over-layers RELATIVE drift RMS: the
+# cora-scale stale/replica runs measure O(1e-2..1e-1) relative drift when
+# healthy; an order of magnitude above that says the carries have left the
+# regime the PipeGCN/CaPGNN convergence story covers, an order below says
+# the syncs are pure overhead.  Both ends are overridable per run.
+DEFAULT_UPPER = 0.5
+DEFAULT_LOWER = 0.02
+
+
+@dataclass
+class CommController:
+    """Drift-banded ``sync_every`` retuner (see module docstring).
+
+    ``observe(step, drift_rel_max)`` is the whole runtime surface: called
+    at each NON-initializing sync/refresh step with the measured
+    max-over-layers relative drift, it returns the sync interval to use
+    from that step on (unchanged when the drift sits inside the band).
+    """
+
+    sync_every: int                      # current target (mutated)
+    upper: float = DEFAULT_UPPER
+    lower: float = DEFAULT_LOWER
+    min_sync: int = 1
+    max_sync: int = 256
+    decisions: list = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.sync_every < 1:
+            raise ValueError(
+                f"the controller retunes a periodic sync schedule; "
+                f"sync_every must be >= 1, got {self.sync_every}")
+        if not (0 <= self.lower < self.upper):
+            raise ValueError(
+                f"need 0 <= lower < upper, got [{self.lower}, {self.upper}]")
+        self.initial_sync_every = self.sync_every
+
+    def observe(self, step: int, drift_rel_max: float) -> int:
+        """One sync-step observation → the (possibly retuned) interval."""
+        old = self.sync_every
+        if drift_rel_max > self.upper:
+            new, rule = max(self.min_sync, old // 2), "drift above band"
+        elif drift_rel_max < self.lower:
+            new, rule = min(self.max_sync, old * 2), "drift below band"
+        else:
+            new, rule = old, "inside band"
+        if new != old:
+            self.decisions.append({
+                "step": int(step),
+                "drift_rel_max": float(drift_rel_max),
+                "band": [float(self.lower), float(self.upper)],
+                "rule": rule,
+                "sync_every": [int(old), int(new)],
+            })
+            self.sync_every = new
+        return self.sync_every
+
+    def log(self) -> dict:
+        """The manifest-ready ``comm_schedule.controller`` block."""
+        return {
+            "kind": "drift-banded sync_every retune",
+            "band": [float(self.lower), float(self.upper)],
+            "initial_sync_every": int(self.initial_sync_every),
+            "sync_every": int(self.sync_every),
+            "retunes": list(self.decisions),
+        }

@@ -91,6 +91,7 @@ class ForwardSetup:
     forward_fn: object            # per-chip forward (MODELS registry)
     init_fn: object               # param init (MODELS registry)
     decision: dict                # resolve_comm_schedule's selection log
+    replica_budget: int = 0       # resolved: 'auto' -> the λ·degree knee B
 
     def ship_arrays(self, plan) -> dict:
         """The plan arrays the forward consumes, ready to shard — including
@@ -112,7 +113,9 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
                           comm_schedule: str | None = None,
                           compute_dtype: str | None = None,
                           halo_staleness: int = 0,
-                          replica_budget: int = 0) -> ForwardSetup:
+                          replica_budget: int | str = 0,
+                          refresh_band: float | None = None
+                          ) -> ForwardSetup:
     """Resolve (schedule, shipped plan fields, static forward kwargs) for one
     plan — the selection logic that used to live inline in
     ``FullBatchTrainer.__init__``, factored out so the forward-only serve
@@ -125,13 +128,26 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
     ``fwd_static`` stays the EXACT forward's statics, because evaluation
     and serving ride ``gcn_forward_local`` on the same (superset) plan
     arrays, with jit pruning the ``nrep_*`` half."""
-    from ..parallel.plan import resolve_comm_schedule
+    from ..parallel.plan import choose_replica_budget, resolve_comm_schedule
 
     decision: dict = {}
     init_fn, forward_fn, fields_fn, static_fn = MODELS[model]
+    if replica_budget == "auto":
+        # --replica-budget auto: the λ·degree-knee rule, resolved BEFORE
+        # the schedule selection so the auto transport scores the wire at
+        # the chosen shrink; the knee log lands in the manifest's
+        # comm_schedule block (docs/replication.md)
+        if model != "gcn":
+            raise ValueError("replica_budget='auto' is a GCN lever "
+                             "(replication is GCN-only)")
+        knee: dict = {}
+        replica_budget = choose_replica_budget(plan, decision=knee)
+        decision["replica_auto"] = knee
+    replica_budget = int(replica_budget or 0)
     comm_schedule = resolve_comm_schedule(
         comm_schedule, [plan], model, halo_staleness,
         fin=fin, widths=list(widths), compute_dtype=compute_dtype,
+        replica_budget=replica_budget if model == "gcn" else 0,
         decision=decision)
     if comm_schedule == "ragged":
         if not plan.symmetric:
@@ -162,12 +178,28 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
         # program = the exact program + replica gathers; evaluate() rides
         # it) and the shrunken no-replica layout; fwd_static stays the
         # exact forward's statics — the replica-only statics
-        # (nrep_rr_sizes, halo table height) live on the trainer
-        from ..parallel.plan import (REPLICA_PLAN_FIELDS,
-                                     REPLICA_PLAN_FIELDS_RAGGED)
+        # (nrep_rr_sizes, halo table height) live on the trainer.  The
+        # composed (replica × stale) step ships its own contract tuples:
+        # the stale carry subsumes the replica tables, so no rep/grep
+        # arrays ride along, and the ragged flavor adds the carry scatter
+        # map ``nrep_ring_dst``.  ``refresh_band`` adds the partial-
+        # refresh side channel (ronly buckets + baselines routing).
+        from ..parallel.plan import (REPLICA_PARTIAL_PLAN_FIELDS,
+                                     REPLICA_PLAN_FIELDS,
+                                     REPLICA_PLAN_FIELDS_RAGGED,
+                                     REPLICA_STALE_PLAN_FIELDS,
+                                     REPLICA_STALE_PLAN_FIELDS_RAGGED)
         plan.ensure_replicas(replica_budget)
-        plan_fields = (REPLICA_PLAN_FIELDS_RAGGED
-                       if comm_schedule == "ragged" else REPLICA_PLAN_FIELDS)
+        if halo_staleness:
+            plan_fields = (REPLICA_STALE_PLAN_FIELDS_RAGGED
+                           if comm_schedule == "ragged"
+                           else REPLICA_STALE_PLAN_FIELDS)
+        elif refresh_band is not None:
+            plan_fields = REPLICA_PARTIAL_PLAN_FIELDS
+        else:
+            plan_fields = (REPLICA_PLAN_FIELDS_RAGGED
+                           if comm_schedule == "ragged"
+                           else REPLICA_PLAN_FIELDS)
     if model == "gcn" and not halo_staleness and not replica_budget \
             and comm_schedule == "a2a":
         # plan-driven kernel choice (VERDICT r3 #9): per-chip tables in
@@ -199,7 +231,7 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
     return ForwardSetup(model=model, comm_schedule=comm_schedule,
                         plan_fields=plan_fields, fwd_static=fwd_static,
                         forward_fn=forward_fn, init_fn=init_fn,
-                        decision=decision)
+                        decision=decision, replica_budget=replica_budget)
 
 
 @dataclass
@@ -333,7 +365,9 @@ class FullBatchTrainer:
         halo_delta: bool = False,
         sync_every: int = 0,
         comm_schedule: str | None = None,
-        replica_budget: int = 0,
+        replica_budget: int | str = 0,
+        refresh_band: float | None = None,
+        auto_tune_sync: bool = False,
     ):
         """``compute_dtype='bfloat16'`` runs forward/backward (including the
         halo exchange — half the ICI bytes) in bf16 with f32 master params
@@ -429,21 +463,22 @@ class FullBatchTrainer:
                 "the replica mode's refresh steps; it requires "
                 "halo_staleness=1 or replica_budget>0 (exact mode is "
                 "always in sync)")
-        if replica_budget < 0:
+        if replica_budget != "auto" and replica_budget < 0:
             raise ValueError(
-                f"replica_budget must be >= 0, got {replica_budget}")
+                f"replica_budget must be >= 0 or 'auto', got "
+                f"{replica_budget}")
         if replica_budget:
             if model != "gcn":
                 raise ValueError(
                     "replica_budget replicates rows of the GCN feature "
                     "exchange; the GAT exchange ships per-layer attention "
                     "tables whose replication is not supported")
-            if halo_staleness:
+            if halo_delta:
                 raise ValueError(
-                    "replica_budget composed with halo_staleness=1 is "
-                    "deferred: the stale carries and the replica carries "
-                    "would share the sync schedule but disagree on what a "
-                    "non-sync exchange ships — run one lever at a time "
+                    "replica_budget composed with halo_delta is deferred: "
+                    "the delta baseline and the replica carry would "
+                    "disagree on what a stale step ships — compose "
+                    "replication with plain --halo-staleness 1 instead "
                     "(docs/replication.md)")
             if not plan.symmetric:
                 raise ValueError(
@@ -456,6 +491,21 @@ class FullBatchTrainer:
                     "trainer (replica carries are f32 state threaded "
                     "through the step); drop compute_dtype/remat or run "
                     "without replication")
+        if refresh_band is not None:
+            if refresh_band < 0:
+                raise ValueError(
+                    f"refresh_band must be >= 0, got {refresh_band}")
+            if not replica_budget:
+                raise ValueError(
+                    "refresh_band schedules the drift-driven PARTIAL "
+                    "replica refresh; it requires replica_budget > 0 "
+                    "(docs/replication.md)")
+            if halo_staleness:
+                raise ValueError(
+                    "refresh_band with halo_staleness=1 is deferred: the "
+                    "composed mode's replica state lives inside the stale "
+                    "halo carry, which partial refresh cannot address per "
+                    "row — run full refreshes there (docs/replication.md)")
         if halo_staleness:
             if model != "gcn":
                 raise ValueError(
@@ -483,15 +533,35 @@ class FullBatchTrainer:
         setup = resolve_forward_setup(
             plan, fin, widths, model=model, comm_schedule=comm_schedule,
             compute_dtype=compute_dtype, halo_staleness=halo_staleness,
-            replica_budget=replica_budget)
+            replica_budget=replica_budget, refresh_band=refresh_band)
         self.comm_decision = setup.decision   # selection → run manifest
         comm_schedule = setup.comm_schedule
+        replica_budget = setup.replica_budget   # 'auto' -> the knee B
         self.comm_schedule = comm_schedule
         self.halo_staleness = halo_staleness
         self.halo_delta = halo_delta
         self.sync_every = sync_every
         self.halo_dtype = halo_dtype
         self.replica_budget = replica_budget
+        self.refresh_band = refresh_band
+        if refresh_band is not None and comm_schedule != "a2a":
+            raise ValueError(
+                "refresh_band rides the dense-a2a replica path; the "
+                "ragged partial-refresh side channel is deferred — run "
+                "--comm-schedule a2a (docs/replication.md)")
+        # mid-run --sync-every retune (docs/comm_schedule.md, controller):
+        # enabled when the schedule was asked as 'auto' (the controller
+        # contract) or explicitly via auto_tune_sync, on any mode with a
+        # sync schedule to tune
+        self.controller = None
+        if ((auto_tune_sync
+             or str(self.comm_decision.get("asked")) == "auto")
+                and sync_every and (halo_staleness or replica_budget)):
+            from .controller import CommController
+            self.controller = CommController(sync_every=sync_every)
+            # the controller block is manifest-visible even before any
+            # retune — "the controller ran and held" is itself a decision
+            self.comm_decision["controller"] = self.controller.log()
         self.plan = plan
         self.fin = fin
         self.widths = list(widths)
@@ -577,13 +647,25 @@ class FullBatchTrainer:
         self._step = self._build_step()
         self._eval = self._build_eval()
         self._multi = {}        # epochs -> compiled on-device epoch loop
+        # composed replica × stale statics (docs/comm_schedule.md): the
+        # stale forward dispatches to the pspmm_replica_stale ops, whose
+        # stale steps ship the SHRUNKEN nrep_* exchange; kept off
+        # _fwd_static so evaluate()'s exact forward never sees them
+        self._rep_stale_static = {}
+        if replica_budget and halo_staleness:
+            self._rep_stale_static = {"replica": True}
+            if comm_schedule == "ragged":
+                self._rep_stale_static["nrep_rr_sizes"] = plan.nrep_rr_sizes
         if halo_staleness:
             # per-layer carry state, stacked per chip and sharded like the
             # plan arrays; zeros are never consumed — the first step (and
             # every sync step) runs the full-sync program, which reads the
             # FRESH exchange and refreshes every carry as a byproduct.
             # Under the composed mode the carries are ROUND-STRUCTURED ring
-            # receive buffers (plan.stale_carry_shapes, schedule-aware).
+            # receive buffers (plan.stale_carry_shapes, schedule-aware);
+            # under replica × stale the SAME carries subsume the replica
+            # tables (replica slots/positions just stop being overwritten
+            # between syncs), so no extra state appears.
             shapes = plan.stale_carry_shapes(fin, widths, delta=halo_delta,
                                              comm_schedule=comm_schedule)
             carry = {
@@ -596,12 +678,14 @@ class FullBatchTrainer:
             self._step_stale = self._build_step_stale(fresh=False)
             self._step_sync = self._build_step_stale(fresh=True)
             self._multi_stale = {}   # epochs -> compiled stale epoch loop
-        if replica_budget:
+        if replica_budget and not halo_staleness:
             # per-layer feature/gradient replica tables, stacked per chip
             # and sharded like the plan arrays; zeros are never consumed —
             # step 0 (and every sync_every-th step) runs the refresh
             # program, which reads the FULL exchange and refreshes every
-            # carry as a byproduct (plan.replica_carry_shapes).
+            # carry as a byproduct (plan.replica_carry_shapes).  (The
+            # composed replica × stale mode carries NO replica state of
+            # its own — the stale halo carry above subsumes it.)
             self._rep_static = (
                 {"comm_schedule": "ragged",
                  "rr_sizes": plan.rr_sizes,
@@ -609,7 +693,10 @@ class FullBatchTrainer:
                  "nrep_rr_sizes": plan.nrep_rr_sizes,
                  "halo_r": plan.r}
                 if comm_schedule == "ragged" else {"comm_schedule": "a2a"})
-            shapes = plan.replica_carry_shapes(fin, widths)
+            partial = refresh_band is not None
+            if partial:
+                self._rep_static = dict(self._rep_static, track_base=True)
+            shapes = plan.replica_carry_shapes(fin, widths, partial=partial)
             carry = {
                 name: [np.zeros((plan.k,) + s, np.float32) for s in shps]
                 for name, shps in shapes.items()
@@ -619,6 +706,12 @@ class FullBatchTrainer:
             self._last_refresh_idx = 0    # refresh-age gauge anchor
             self._step_rep = self._build_step_replica(fresh=False)
             self._step_rep_sync = self._build_step_replica(fresh=True)
+            if partial:
+                # the drift-banded partial refresh program (the
+                # --refresh-band refresh step; step 0 stays FULL — it
+                # initializes the carries and baselines)
+                self._step_rep_partial = self._build_step_replica(
+                    fresh=False, partial=True)
             self._multi_rep = {}     # epochs -> compiled replica epoch loop
 
     # ------------------------------------------------------------------ build
@@ -693,6 +786,7 @@ class FullBatchTrainer:
             fresh=fresh,
             gauges=gauges,
             **ragged,
+            **self._rep_stale_static,
         )
         if gauges:
             logits, nh, nb, qe = out
@@ -823,7 +917,11 @@ class FullBatchTrainer:
         telemetry, else ``None``."""
         sync_step = self._stale_sync_due()
         age = self._stale_step_idx - self._last_sync_idx
-        if self.recorder is not None:
+        first = sync_step and self._stale_step_idx == 0
+        telemetry = self.recorder is not None or (
+            self.controller is not None and sync_step)
+        if telemetry:
+            self._ensure_tel_programs()
             prog = self._step_sync_tel if sync_step else self._step_stale_tel
             (self.params, self.opt_state, self.halo_carry, loss, err, gnorm,
              gauges) = prog(
@@ -831,6 +929,8 @@ class FullBatchTrainer:
                 data.h0, data.labels, data.train_valid,
             )
             extra = (gnorm, gauges, age, sync_step)
+            if sync_step:
+                self._controller_observe(gauges, kind="stale", first=first)
         else:
             prog = self._step_sync if sync_step else self._step_stale
             (self.params, self.opt_state, self.halo_carry, loss, err) = prog(
@@ -843,17 +943,27 @@ class FullBatchTrainer:
         self._stale_step_idx += 1
         # per-step feature-wire itemsize: a delta-mode SYNC step re-bases
         # with the full f32 row (ops/pspmm.py::_stale_exchange), so its
-        # wire bytes are booked at 4, not the stale steps' bf16 2
+        # wire bytes are booked at 4, not the stale steps' bf16 2.
+        # Composed replica × stale: a stale step's hidden exchange ships
+        # the SHRUNKEN wire (replica=True booking); sync steps the full one
         self.stats.count_step(
             nlayers=self.nlayers, hidden=not sync_step,
-            wire_itemsize=4 if (self.halo_delta and sync_step) else None)
+            wire_itemsize=4 if (self.halo_delta and sync_step) else None,
+            replica=bool(self.replica_budget) and not sync_step)
         return loss, err, extra
 
     # ---------------------------------------------------- hot-halo replicas
-    def _forward_replica(self, params, pa, h0, reps, greps, fresh: bool):
+    def _forward_replica(self, params, pa, h0, reps, greps, fresh: bool,
+                         bases=None, partial: bool = False):
         from ..models.gcn import gcn_forward_local_replica
 
-        logits, new_reps = gcn_forward_local_replica(
+        extra = {}
+        if self.refresh_band is not None:
+            extra["rep_base"] = bases
+            if partial:
+                extra["partial_step"] = True
+                extra["band"] = float(self.refresh_band)
+        out = gcn_forward_local_replica(
             params, h0, pa, reps, greps,
             activation=self.activation,
             final_activation=self.final_activation,
@@ -861,11 +971,17 @@ class FullBatchTrainer:
             halo_dtype=self.halo_dtype,
             fresh=fresh,
             **self._rep_static,
+            **extra,
         )
-        return logits.astype("float32"), new_reps
+        if self.refresh_band is not None:
+            logits, new_reps, new_bases, nships = out
+            return logits.astype("float32"), new_reps, new_bases, nships
+        logits, new_reps = out
+        return logits.astype("float32"), new_reps, None, None
 
     def _one_step_replica(self, params, opt_state, carry, pa, h0, labels,
-                          valid, fresh: bool, telemetry: bool = False):
+                          valid, fresh: bool, partial: bool = False,
+                          telemetry: bool = False):
         """One per-chip training step under hot-halo replication.
 
         The gradient-replica carries ride jax's cotangent machinery exactly
@@ -874,6 +990,13 @@ class FullBatchTrainer:
         the "gradient" of each ``greps[ℓ]``, the refreshed gradient-replica
         table on sync steps (the carry itself on replica steps).
 
+        ``partial=True`` compiles the drift-banded PARTIAL refresh step
+        (``--refresh-band``, ``pspmm_replica_partial``): the shrunken
+        exchange plus the replica-only side channel of masked deltas; the
+        program additionally returns the per-layer psum'd count of
+        side-channel slots that actually carried a row — the booking
+        figure ``CommStats.count_partial_refresh_step`` consumes.
+
         ``telemetry=True`` additionally returns ``(gnorm, gauges)`` — the
         replica drift gauges (``docs/replication.md``), psum'd to global
         scalars: ``drift_sq[ℓ]`` = ``Σ (rep_next − rep_in)²`` (the drift a
@@ -881,23 +1004,33 @@ class FullBatchTrainer:
         pass through) and ``ref_sq[ℓ]`` = ``Σ rep_next²``, its normalizer.
         """
         reps, greps = carry["reps"], carry["greps"]
+        bases = carry.get("rep_base")
 
         def loss_fn(ps, gr):
-            logits, nr = self._forward_replica(ps, pa, h0, reps, gr, fresh)
+            logits, nr, nb, ns = self._forward_replica(
+                ps, pa, h0, reps, gr, fresh, bases=bases, partial=partial)
             loss = self._loss_fn(logits, labels, valid)
             err = (masked_err_local(logits, labels, valid)
                    if self.loss_name == "bce" else loss)
-            return loss, (err, nr)
+            return loss, (err, nr, nb, ns)
 
-        (loss, (err, nr)), (grads, ngr) = jax.value_and_grad(
+        (loss, (err, nr, nb, ns)), (grads, ngr) = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True)(params, greps)
         grads = jax.tree.map(lambda g: lax.psum(g, AXIS), grads)
         updates, opt_state = self.opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         new_carry = {"reps": nr, "greps": list(ngr)}
-        if not telemetry:
-            return params, opt_state, new_carry, loss, err
+        if nb is not None:
+            new_carry["rep_base"] = nb
         import jax.numpy as jnp
+        extra_out = ()
+        if partial:
+            # ACTUAL shipped side-channel rows per layer (global): the
+            # booking figure — forward count; the gradient side channel
+            # ships the same masked rows (count_partial books ×2)
+            extra_out = (jnp.stack([lax.psum(s, AXIS) for s in ns]),)
+        if not telemetry:
+            return (params, opt_state, new_carry, loss, err) + extra_out
         gauges = {
             "drift_sq": jnp.stack([
                 lax.psum(jnp.sum(jnp.square(n - o)), AXIS)
@@ -905,25 +1038,26 @@ class FullBatchTrainer:
             "ref_sq": jnp.stack([
                 lax.psum(jnp.sum(jnp.square(n)), AXIS) for n in nr]),
         }
-        return (params, opt_state, new_carry, loss, err,
-                _global_grad_norm(grads), gauges)
+        return (params, opt_state, new_carry, loss, err) + extra_out + (
+            _global_grad_norm(grads), gauges)
 
-    def _build_step_replica(self, fresh: bool, telemetry: bool = False):
+    def _build_step_replica(self, fresh: bool, partial: bool = False,
+                            telemetry: bool = False):
         def per_chip(params, opt_state, carry, pa, h0, labels, valid):
             carry, pa, h0, labels, valid = _unblock(
                 (carry, pa, h0, labels, valid))
             out = self._one_step_replica(
                 params, opt_state, carry, pa, h0, labels, valid, fresh,
-                telemetry=telemetry)
+                partial=partial, telemetry=telemetry)
             params, opt_state, carry = out[:3]
             return (params, opt_state, _reblock(carry)) + out[3:]
 
+        n_extra = (1 if partial else 0) + (2 if telemetry else 0)
         smapped = jax.shard_map(
             per_chip,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
-            out_specs=(P(), P(), P(AXIS), P(), P()) + ((P(), P())
-                                                       if telemetry else ()),
+            out_specs=(P(), P(), P(AXIS), P(), P()) + (P(),) * n_extra,
         )
         return jax.jit(smapped, donate_argnums=(0, 1, 2))
 
@@ -970,34 +1104,66 @@ class FullBatchTrainer:
     def _replica_run_one(self, data: TrainData):
         """One replica-mode optimizer step (refresh or shrunken-wire per
         schedule).  Returns ``(loss, err, extra)`` with ``extra`` =
-        ``(gnorm, gauges, refresh_age, sync_step)`` under telemetry."""
+        ``(gnorm, gauges, refresh_age, sync_step, first, refresh_rows)``
+        under telemetry.
+
+        With ``--refresh-band`` set, the scheduled refresh steps (every
+        refresh EXCEPT step 0, which must initialize the carries and
+        baselines in full) run the PARTIAL program instead: the per-layer
+        counts of actually-shipped side-channel rows come back as a step
+        output and are booked at their true value
+        (``CommStats.count_partial_refresh_step``)."""
         sync_step = self._replica_sync_due()
         age = self._rep_step_idx - self._last_refresh_idx
         first = sync_step and self._rep_step_idx == 0
-        if self.recorder is not None:
-            prog = (self._step_rep_sync_tel if sync_step
+        partial = (sync_step and not first
+                   and self.refresh_band is not None)
+        telemetry = self.recorder is not None or (
+            self.controller is not None and sync_step)
+        refresh_rows = None
+        args = (self.params, self.opt_state, self.replica_carry, self.pa,
+                data.h0, data.labels, data.train_valid)
+        if telemetry:
+            self._ensure_tel_programs()
+            prog = (self._step_rep_partial_tel if partial
+                    else self._step_rep_sync_tel if sync_step
                     else self._step_rep_tel)
-            (self.params, self.opt_state, self.replica_carry, loss, err,
-             gnorm, gauges) = prog(
-                self.params, self.opt_state, self.replica_carry, self.pa,
-                data.h0, data.labels, data.train_valid,
-            )
-            extra = (gnorm, gauges, age, sync_step, first)
-        else:
-            prog = self._step_rep_sync if sync_step else self._step_rep
+            out = prog(*args)
             (self.params, self.opt_state, self.replica_carry, loss,
-             err) = prog(
-                self.params, self.opt_state, self.replica_carry, self.pa,
-                data.h0, data.labels, data.train_valid,
-            )
+             err) = out[:5]
+            if partial:
+                refresh_rows = np.asarray(out[5]).astype(np.int64)
+            gnorm, gauges = out[-2], out[-1]
+            extra = (gnorm, gauges, age, sync_step, first, refresh_rows)
+            if sync_step:
+                self._controller_observe(gauges, kind="replica",
+                                         first=first)
+        else:
+            prog = (self._step_rep_partial if partial
+                    else self._step_rep_sync if sync_step
+                    else self._step_rep)
+            out = prog(*args)
+            (self.params, self.opt_state, self.replica_carry, loss,
+             err) = out[:5]
+            if partial:
+                refresh_rows = np.asarray(out[5]).astype(np.int64)
             extra = None
         if sync_step:
             self._last_refresh_idx = self._rep_step_idx
         self._rep_step_idx += 1
         # replica steps ship the shrunken wire (and the shrunken TRUE
-        # volume — replicated rows genuinely leave the exchange); refresh
-        # steps ship the full exact exchange
-        self.stats.count_step(nlayers=self.nlayers, replica=not sync_step)
+        # volume — replicated rows genuinely leave the exchange); full
+        # refresh steps ship the full exact exchange; PARTIAL refresh
+        # steps ship the shrunken wire plus the side channel, booked at
+        # the ACTUAL per-layer shipped rows read back above
+        if partial:
+            self.stats.count_partial_refresh_step(
+                nlayers=self.nlayers,
+                refresh_rows=[int(x) for x in refresh_rows],
+                wire_rows=int(self.plan.partial_refresh_wire_rows))
+        else:
+            self.stats.count_step(nlayers=self.nlayers,
+                                  replica=not sync_step)
         return loss, err, extra
 
     def _run_epochs_replica(self, data: TrainData, epochs: int, sync: bool):
@@ -1008,10 +1174,57 @@ class FullBatchTrainer:
             carry_attr="replica_carry", idx_attr="_rep_step_idx",
             count_kwargs={"replica": True})
 
+    def _ensure_tel_programs(self) -> None:
+        """Compile the telemetry step variants on first need — attached
+        recorder (``attach_recorder``) or an active controller (which
+        reads the drift gauges at sync/refresh steps even without a run
+        directory).  ``jax.jit`` wrappers are lazy, so building them
+        eagerly costs nothing until dispatch."""
+        if getattr(self, "_step_tel", None) is None:
+            self._step_tel = self._build_step(telemetry=True)
+        if self.halo_staleness and \
+                getattr(self, "_step_stale_tel", None) is None:
+            self._step_stale_tel = self._build_step_stale(
+                fresh=False, telemetry=True)
+            self._step_sync_tel = self._build_step_stale(
+                fresh=True, telemetry=True)
+        if self.replica_budget and not self.halo_staleness and \
+                getattr(self, "_step_rep_tel", None) is None:
+            self._step_rep_tel = self._build_step_replica(
+                fresh=False, telemetry=True)
+            self._step_rep_sync_tel = self._build_step_replica(
+                fresh=True, telemetry=True)
+            if self.refresh_band is not None:
+                self._step_rep_partial_tel = self._build_step_replica(
+                    fresh=False, partial=True, telemetry=True)
+
+    def _controller_observe(self, gauges, kind: str,
+                            first: bool = False) -> None:
+        """Feed a sync/refresh step's measured drift to the controller and
+        apply its (possibly unchanged) ``sync_every`` target.  The
+        INITIALIZING refresh is skipped — its in-graph gauge compares
+        against the zero-init carry, so it measures initialization
+        magnitude, not drift (the PR-10 lesson).  Every retune decision is
+        appended to the manifest ``comm_schedule.controller`` log."""
+        if self.controller is None or first:
+            return
+        d = np.sqrt(np.maximum(
+            np.asarray(gauges["drift_sq"], np.float64), 0))
+        r = np.sqrt(np.maximum(np.asarray(gauges["ref_sq"], np.float64), 0))
+        rel = float(np.max(d / np.maximum(r, 1e-30))) if d.size else 0.0
+        step_idx = (self._rep_step_idx if kind == "replica"
+                    else self._stale_step_idx)
+        self.sync_every = self.controller.observe(step_idx, rel)
+        self.comm_decision["controller"] = self.controller.log()
+        if self.recorder is not None:
+            self.recorder.set_comm_schedule(self.comm_decision)
+
     @staticmethod
     def _replica_fields(gauges: dict, age: int, sync_step: bool,
                         replica_rows: int,
-                        first_refresh: bool = False) -> dict:
+                        first_refresh: bool = False,
+                        refresh_rows=None,
+                        refresh_wire_rows: int | None = None) -> dict:
         """Host-side rendering of the in-graph replica gauges into the
         schema's ``replica`` block (``obs.schema.REPLICA_KEYS``): per-layer
         ‖replica − fresh‖ at each refresh (zero between refreshes — fresh
@@ -1028,7 +1241,7 @@ class FullBatchTrainer:
         r = np.sqrt(np.maximum(np.asarray(gauges["ref_sq"], np.float64), 0))
         if first_refresh:
             d = np.zeros_like(d)
-        return {
+        out = {
             "refresh_age": int(age),
             "sync_step": bool(sync_step),
             "replica_rows": int(replica_rows),
@@ -1036,6 +1249,18 @@ class FullBatchTrainer:
             "replica_drift_rel": [float(x / max(y, 1e-30))
                                   for x, y in zip(d, r)],
         }
+        if refresh_rows is not None:
+            # drift-banded PARTIAL refresh (--refresh-band): the ACTUAL
+            # per-layer side-channel rows this step shipped (each consumer
+            # copy counts, like every send-volume gauge) — the per-step
+            # face of CommStats' partial_refresh_* totals, which must
+            # reconcile exactly (docs/replication.md)
+            out["refresh_kind"] = "partial"
+            out["refresh_rows"] = [int(x) for x in refresh_rows]
+            out["refresh_wire_rows"] = int(refresh_wire_rows or 0)
+        elif sync_step:
+            out["refresh_kind"] = "full"
+        return out
 
     def _build_step(self, mesh=None, telemetry: bool = False):
         def per_chip(params, opt_state, pa, h0, labels, valid):
@@ -1082,16 +1307,23 @@ class FullBatchTrainer:
         donation contracts of the lowered module."""
         from jax.sharding import NamedSharding
 
-        if kind not in ("step", "stale", "sync", "rep", "rep_sync"):
+        if kind not in ("step", "stale", "sync", "rep", "rep_sync",
+                        "rep_partial"):
             raise ValueError(f"unknown step kind {kind!r}")
         if kind in ("stale", "sync") and not self.halo_staleness:
             raise ValueError(
                 f"kind={kind!r} lowers the stale-mode programs; this "
                 "trainer runs exact mode (halo_staleness=0)")
-        if kind in ("rep", "rep_sync") and not self.replica_budget:
+        if kind in ("rep", "rep_sync") and not (self.replica_budget
+                                               and not self.halo_staleness):
             raise ValueError(
                 f"kind={kind!r} lowers the replica-mode programs; this "
-                "trainer runs without replication (replica_budget=0)")
+                "trainer runs without standalone replication (the composed "
+                "replica × stale programs lower via kind='stale'/'sync')")
+        if kind == "rep_partial" and self.refresh_band is None:
+            raise ValueError(
+                "kind='rep_partial' lowers the --refresh-band partial "
+                "refresh program; this trainer runs full refreshes")
         if kind != "step" and mesh not in (None, self.mesh):
             raise ValueError(
                 "carry-threading step programs are built against the "
@@ -1119,7 +1351,9 @@ class FullBatchTrainer:
             prog = {"stale": getattr(self, "_step_stale", None),
                     "sync": getattr(self, "_step_sync", None),
                     "rep": getattr(self, "_step_rep", None),
-                    "rep_sync": getattr(self, "_step_rep_sync", None)}[kind]
+                    "rep_sync": getattr(self, "_step_rep_sync", None),
+                    "rep_partial": getattr(self, "_step_rep_partial",
+                                           None)}[kind]
             return prog.lower(params, opt_state, carry, pa, h0, labels,
                               valid)
         return self._build_step(mesh=mesh).lower(
@@ -1204,7 +1438,10 @@ class FullBatchTrainer:
             sync_due=self._stale_sync_due, run_one=self._stale_run_one,
             multi=self._multi_stale, build_multi=self._build_multi_stale,
             carry_attr="halo_carry", idx_attr="_stale_step_idx",
-            count_kwargs={"hidden": True})
+            # composed replica × stale: the fused stale steps ship the
+            # shrunken wire AND hide it — book both
+            count_kwargs={"hidden": True,
+                          "replica": bool(self.replica_budget)})
 
     def _run_epochs_carried(self, data: TrainData, epochs: int, sync: bool,
                             *, sync_due, run_one, multi, build_multi,
@@ -1291,17 +1528,7 @@ class FullBatchTrainer:
             # the run manifest, so an 'auto' pick is reconstructible from
             # the run directory alone (docs/observability.md)
             recorder.set_comm_schedule(self.comm_decision)
-        self._step_tel = self._build_step(telemetry=True)
-        if self.halo_staleness:
-            self._step_stale_tel = self._build_step_stale(
-                fresh=False, telemetry=True)
-            self._step_sync_tel = self._build_step_stale(
-                fresh=True, telemetry=True)
-        if self.replica_budget:
-            self._step_rep_tel = self._build_step_replica(
-                fresh=False, telemetry=True)
-            self._step_rep_sync_tel = self._build_step_replica(
-                fresh=True, telemetry=True)
+        self._ensure_tel_programs()
 
     def _step_cost_model(self, sync_step: bool = True):
         """Per-step-kind analytic cost: under ``--halo-delta`` the FEATURE
@@ -1355,11 +1582,25 @@ class FullBatchTrainer:
         if "pallas_tb" not in self._fwd_static:
             sync_like = drift is None or bool(drift.get("sync_step"))
             if replica is not None:
-                # replica steps price the shrunken exchange; refresh steps
-                # the full one.  Exposure is NOT affected — every replica-
-                # mode exchange has a same-step consumer (unlike staleness)
-                sync_like = bool(replica.get("sync_step"))
+                # replica steps price the shrunken exchange; FULL refresh
+                # steps the full one; PARTIAL refresh steps the shrunken
+                # exchange plus the side channel at the step's ACTUAL
+                # shipped rows (add_partial_refresh — CommStats books the
+                # identical figures, so the two reconcile per step).
+                # Exposure is NOT affected — every replica-mode exchange
+                # has a same-step consumer (unlike staleness)
+                partial = replica.get("refresh_kind") == "partial"
+                sync_like = bool(replica.get("sync_step")) and not partial
             cost = self._step_cost_model(sync_like)
+            if replica is not None and partial:
+                from ..obs.attribution import add_partial_refresh
+                bwd_item = (self.stats.wire_itemsize_bwd
+                            if self.stats.wire_itemsize_bwd is not None
+                            else self.stats.wire_itemsize)
+                cost = add_partial_refresh(
+                    cost, replica["refresh_rows"],
+                    replica["refresh_wire_rows"],
+                    self.stats.wire_itemsize, bwd_item)
             ex_step = 2 * self.nlayers      # this step's exchanges
             exposed_step = 0 if (drift is not None
                                  and not drift.get("sync_step")) else ex_step
@@ -1464,12 +1705,15 @@ class FullBatchTrainer:
             self.last_err = err
             self._step_count += 1
             if self.recorder is not None:
-                gnorm, gauges, age, sync_step, first = extra
+                gnorm, gauges, age, sync_step, first, rrows = extra
                 self._record_step_event(
                     loss, err, gnorm, sp.dur_s, drift=None,
                     replica=self._replica_fields(
                         gauges, age, sync_step, self.plan.replica_rows,
-                        first_refresh=first))
+                        first_refresh=first, refresh_rows=rrows,
+                        refresh_wire_rows=(
+                            int(self.plan.partial_refresh_wire_rows)
+                            if rrows is not None else None)))
                 return loss
             return float(loss) if sync else loss
         if self.recorder is not None:

@@ -80,6 +80,22 @@ class CommStats:
     replica_rows: int = 0                 # plan.replica_rows (gauge only)
     replica_exchanges: int = 0            # exchanges that rode the shrunken
     #                                       wire (subset of ``exchanges``)
+    # COMPOSED replica × stale booking: replica-booked exchanges that were
+    # ALSO latency-hidden (subset of both ``replica_exchanges`` and
+    # ``hidden_exchanges``) — the pure replica mode keeps every shrunken
+    # exchange synchronous, the composed mode hides all of them, and the
+    # exposed/hidden volume split must price each subset at its own
+    # per-exchange figure or the hidden + exposed == total contract breaks.
+    hidden_replica_exchanges: int = 0
+    # Drift-banded PARTIAL refresh (``--refresh-band``,
+    # docs/replication.md): the refresh side channel's cumulative booking,
+    # at the ACTUAL per-step shipped rows the program reported (these ride
+    # ON TOP of the shrunken base exchange the step is replica-booked at;
+    # the per-step face is the step event's ``replica.refresh_rows`` — the
+    # two must reconcile exactly).
+    partial_refresh_steps: int = 0
+    partial_refresh_rows_total: int = 0        # true rows, fwd + bwd
+    partial_refresh_wire_rows_total: int = 0   # padded side-channel rows
 
     @classmethod
     def from_plan(cls, plan, schedule: str = "a2a",
@@ -186,8 +202,41 @@ class CommStats:
             self.hidden_exchanges += 2 * nlayers
         if replica:
             self.replica_exchanges += 2 * nlayers
+        if hidden and replica:
+            # composed replica × stale: the shrunken exchange is ALSO off
+            # the critical path — the split volumes price it accordingly
+            self.hidden_replica_exchanges += 2 * nlayers
         self._accumulate_bytes(1, 1, fwd_itemsize=wire_itemsize,
                                replica=replica)
+
+    def count_partial_refresh_step(self, nlayers: int, refresh_rows,
+                                   wire_rows: int) -> None:
+        """One ``--refresh-band`` PARTIAL refresh step: the shrunken
+        replica-step exchange (booked exactly like
+        ``count_step(replica=True)``) plus the replica-only side channel —
+        one extra a2a per layer per direction shipping ``wire_rows``
+        padded rows, of which ``refresh_rows[ℓ]`` (the per-layer count the
+        program measured and reported) actually carried a drifted row.
+        The gradient side channel ships the same masked rows plus a 0/1
+        indicator lane (one extra f32-equivalent lane in the byte gauge).
+        """
+        refresh_rows = [int(x) for x in refresh_rows]
+        if len(refresh_rows) != nlayers:
+            raise ValueError(
+                f"count_partial_refresh_step: {len(refresh_rows)} per-layer "
+                f"row counts for {nlayers} layers")
+        self.count_step(nlayers=nlayers, replica=True)
+        self.partial_refresh_steps += 1
+        self.partial_refresh_rows_total += 2 * sum(refresh_rows)
+        self.partial_refresh_wire_rows_total += 2 * nlayers * int(wire_rows)
+        if self.lane_widths:
+            fwd = self.wire_itemsize
+            bwd = (self.wire_itemsize if self.wire_itemsize_bwd is None
+                   else self.wire_itemsize_bwd)
+            for rows, lane in zip(refresh_rows, self.lane_widths):
+                self.halo_bytes_true_total += rows * lane * (fwd + bwd)
+                self.halo_bytes_wire_total += int(wire_rows) * (
+                    lane * fwd + (lane + 1) * bwd)
 
     def count_forward(self, nlayers: int) -> None:
         self.exchanges += nlayers
@@ -234,30 +283,46 @@ class CommStats:
         bytes cross the wire either way)."""
         rep = self.report_from_cumulative(*self.cumulative())
         exposed = self.exchanges - self.hidden_exchanges
+        hidden = self.hidden_exchanges
         per_ex = int(self.send_volume_per_exchange.sum())
         rex = self.replica_exchanges
+        hrex = self.hidden_replica_exchanges   # composed replica × stale
+        erex = rex - hrex                      # exposed replica-booked
         per_ex_rep = (int(self.replica_send_volume_per_exchange.sum())
                       if rex else per_ex)
         rep_wire = (self.replica_wire_rows_per_exchange
                     if rex else self.wire_rows_per_exchange)
+        wire = self.wire_rows_per_exchange
+        # the --refresh-band side channel's padded rows ride on (exposed)
+        # refresh steps — they join every wire total below
+        pwire = self.partial_refresh_wire_rows_total
         rep.update(
             exchanges=self.exchanges,
             exposed_exchanges=exposed,
-            hidden_exchanges=self.hidden_exchanges,
-            # replica-booked exchanges are always exposed (the trainer
-            # gates hidden × replica apart), at their shrunken volume
-            exposed_send_volume=(per_ex * (exposed - rex)
-                                 + per_ex_rep * rex),
-            hidden_send_volume=per_ex * self.hidden_exchanges,
+            hidden_exchanges=hidden,
+            # each (exposed/hidden) × (full/replica-booked) subset prices
+            # at its own per-exchange volume, so hidden + exposed == total
+            # holds in every mode (pure replica: all shrunken exchanges
+            # exposed; composed replica × stale: all of them hidden)
+            exposed_send_volume=(per_ex * (exposed - erex)
+                                 + per_ex_rep * erex),
+            hidden_send_volume=(per_ex * (hidden - hrex)
+                                + per_ex_rep * hrex),
             # per-schedule padded-vs-true accounting: true rows are what the
             # partitioner optimizes, wire rows what the schedule ships; the
             # obs roofline must agree with these EXACTLY
             # (tests/test_metrics_cli.py)
             comm_schedule=self.schedule,
             true_rows_per_exchange=per_ex,
-            wire_rows_per_exchange=self.wire_rows_per_exchange,
-            wire_rows_total=(self.wire_rows_per_exchange
-                             * (self.exchanges - rex) + rep_wire * rex),
+            wire_rows_per_exchange=wire,
+            wire_rows_total=(wire * (self.exchanges - rex)
+                             + rep_wire * rex + pwire),
+            # the exposed/hidden WIRE-row split — the controller A/B's
+            # acceptance figure (exposed wire rows/step, never epoch time)
+            exposed_wire_rows_total=(wire * (exposed - erex)
+                                     + rep_wire * erex + pwire),
+            hidden_wire_rows_total=(wire * (hidden - hrex)
+                                    + rep_wire * hrex),
             padding_efficiency=self.padding_efficiency,
         )
         if self.replica_wire_rows_per_exchange is not None:
@@ -266,11 +331,21 @@ class CommStats:
             # many exchanges rode it
             rep.update(
                 replica_exchanges=rex,
+                hidden_replica_exchanges=hrex,
                 replica_rows=self.replica_rows,
                 true_rows_per_exchange_replica=int(
                     self.replica_send_volume_per_exchange.sum()),
                 wire_rows_per_exchange_replica=
                 self.replica_wire_rows_per_exchange,
+            )
+        if self.partial_refresh_steps:
+            # partial-refresh booking at the ACTUAL shipped rows — the
+            # cumulative face of the step events' replica.refresh_rows
+            rep.update(
+                partial_refresh_steps=self.partial_refresh_steps,
+                partial_refresh_rows_total=self.partial_refresh_rows_total,
+                partial_refresh_wire_rows_total=
+                self.partial_refresh_wire_rows_total,
             )
         if self.lane_widths:
             # lane-weighted byte gauges: one fwd + one bwd exchange per
@@ -313,24 +388,33 @@ class CommStats:
         wire_total = sum(
             s.wire_rows_per_exchange * (s.exchanges - s.replica_exchanges)
             + (s.replica_wire_rows_per_exchange or 0) * s.replica_exchanges
+            + s.partial_refresh_wire_rows_total
             for s in stats_list)
+
+        def _split_vol(s, hidden_side: bool) -> int:
+            # same subset pricing as a single report(): (exposed/hidden) ×
+            # (full/replica-booked), each at its own per-exchange volume —
+            # the composed replica × stale mode hides shrunken exchanges,
+            # so the old "replica implies exposed" shortcut would misprice
+            # exactly the mode this split exists to describe
+            per = int(s.send_volume_per_exchange.sum())
+            per_rep = (int(s.replica_send_volume_per_exchange.sum())
+                       if s.replica_exchanges else per)
+            hrex = s.hidden_replica_exchanges
+            if hidden_side:
+                return (per * (s.hidden_exchanges - hrex) + per_rep * hrex)
+            erex = s.replica_exchanges - hrex
+            exp = s.exchanges - s.hidden_exchanges
+            return per * (exp - erex) + per_rep * erex
+
         rep.update(
             exchanges=exchanges,
             exposed_exchanges=exchanges - hidden,
             hidden_exchanges=hidden,
-            # replica-booked exchanges are exposed at their SHRUNKEN volume
-            # (hidden × replica never co-occur — the trainer gates them
-            # apart), so the merged report keeps the same hidden + exposed
-            # == total reconciliation contract as a single report()
-            exposed_send_volume=sum(
-                int(s.send_volume_per_exchange.sum())
-                * (s.exchanges - s.hidden_exchanges - s.replica_exchanges)
-                + (int(s.replica_send_volume_per_exchange.sum())
-                   if s.replica_exchanges else 0) * s.replica_exchanges
-                for s in stats_list),
-            hidden_send_volume=sum(
-                int(s.send_volume_per_exchange.sum()) * s.hidden_exchanges
-                for s in stats_list),
+            exposed_send_volume=sum(_split_vol(s, False)
+                                    for s in stats_list),
+            hidden_send_volume=sum(_split_vol(s, True)
+                                   for s in stats_list),
             # cross-counter wire accounting: each counter's wire rows are
             # its OWN plan's (per-batch envelopes differ), so totals sum per
             # counter; efficiency is the cumulative true/wire ratio

@@ -81,8 +81,11 @@ def test_matrix_covers_the_advertised_axes(full_report):
             "minibatch/gcn/ragged/s0/f32",
             "train/gcn/a2a/s0/f32/rep", "train/gcn/a2a/s0/bf16/rep",
             "train/gcn/ragged/s0/f32/rep", "train/gcn/ragged/s0/bf16/rep",
+            "train/gcn/a2a/s1/f32/rep", "train/gcn/a2a/s1/bf16/rep",
+            "train/gcn/ragged/s1/f32/rep", "train/gcn/ragged/s1/bf16/rep",
             "train/gcn/ragged/s0/f32@banded",
-            "train/gcn/ragged/s1/f32@banded"):
+            "train/gcn/ragged/s1/f32@banded",
+            "train/gcn/ragged/s1/f32/rep@banded"):
         assert required in ids, f"mode {required} missing from the audit"
 
 
@@ -110,6 +113,12 @@ def test_replica_modes_audit_both_programs_and_shrink_the_wire(full_report):
     assert plan.nrep_s < plan.s
     assert sum(plan.nrep_rr_sizes) < sum(plan.rr_sizes)
     for mid, entry in full_report["modes"].items():
+        if mid.endswith("/rep") and "/s1/" in mid:
+            # the COMPOSED replica × stale modes lower the stale/sync
+            # program pair (the stale carry subsumes the replica tables);
+            # the shrunken-wire contract is the stale program's census
+            assert set(entry["programs"]) == {"stale", "sync"}, mid
+            continue
         if mid.endswith("/rep"):
             assert set(entry["programs"]) == {"rep", "sync"}, mid
             # same dispatch COUNTS (no round became empty at this budget),
@@ -221,14 +230,15 @@ def test_composition_matrix_matches_doc():
         ("a2a", 0, True, False, "gat"): False,
         ("ragged", 0, True, False, "gcn"): False,
         ("ragged", 0, True, False, "gat"): False,
-        # hot-halo replication: GCN-only, exact transports; composition
-        # with the stale pipeline is deferred (docs/replication.md)
+        # hot-halo replication: GCN-only; composes with the stale
+        # pipeline (PR-12: the stale carry subsumes the replica tables),
+        # but not with the delta cache (docs/replication.md)
         ("a2a", 0, False, True, "gcn"): True,
         ("ragged", 0, False, True, "gcn"): True,
         ("a2a", 0, False, True, "gat"): False,
         ("ragged", 0, False, True, "gat"): False,
-        ("a2a", 1, False, True, "gcn"): False,
-        ("ragged", 1, False, True, "gcn"): False,
+        ("a2a", 1, False, True, "gcn"): True,
+        ("ragged", 1, False, True, "gcn"): True,
         ("a2a", 1, True, True, "gcn"): False,
         ("ragged", 1, True, True, "gcn"): False,
     }

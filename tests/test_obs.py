@@ -55,9 +55,11 @@ def test_commstats_conservation_and_report():
         "exposed_exchanges", "hidden_exchanges", "exposed_send_volume",
         "hidden_send_volume",
         # the padded-vs-true wire split of the selected exchange schedule
-        # (docs/comm_schedule.md)
+        # (docs/comm_schedule.md), including the exposed/hidden wire-row
+        # split the controller A/B judges on (PR-12)
         "comm_schedule", "true_rows_per_exchange", "wire_rows_per_exchange",
-        "wire_rows_total", "padding_efficiency"}
+        "wire_rows_total", "exposed_wire_rows_total",
+        "hidden_wire_rows_total", "padding_efficiency"}
     # wire accounting defaults to the dense a2a schedule and reconciles
     assert rep["comm_schedule"] == "a2a"
     assert rep["true_rows_per_exchange"] == per_ex

@@ -232,14 +232,19 @@ def test_replica_gating(cora):
     with pytest.raises(ValueError, match="GAT"):
         FullBatchTrainer(plan, fin=fin, widths=WIDTHS, model="gat",
                          replica_budget=8)
+    # replica × staleness COMPOSES since PR-12 (tests/test_replica_stale.py);
+    # the remaining deferred composition is the delta cache
     with pytest.raises(ValueError, match="deferred"):
         FullBatchTrainer(plan, fin=fin, widths=WIDTHS, halo_staleness=1,
-                         replica_budget=8)
+                         halo_delta=True, replica_budget=8)
     with pytest.raises(ValueError, match="f32 non-remat"):
         FullBatchTrainer(plan, fin=fin, widths=WIDTHS,
                          compute_dtype="bfloat16", replica_budget=8)
     with pytest.raises(ValueError, match="replica_budget must be >= 0"):
         FullBatchTrainer(plan, fin=fin, widths=WIDTHS, replica_budget=-1)
+    with pytest.raises(ValueError, match="replication is not supported"):
+        FullBatchTrainer(plan, fin=fin, widths=WIDTHS, model="gat",
+                         replica_budget="auto")
     # sync_every now legal with EITHER lever, still not alone
     with pytest.raises(ValueError, match="sync_every"):
         FullBatchTrainer(plan, fin=fin, widths=WIDTHS, sync_every=2)

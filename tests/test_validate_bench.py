@@ -203,6 +203,62 @@ def test_validator_replica_ab_contract():
         {"replica_ab_8dev": _replica_block(note="timings only")}))
 
 
+def _ctrl_arm(exposed, **over):
+    a = {"epoch_s": 0.01, "steps": 60, "wire_rows_per_exchange": 12000,
+         "exposed_comm_frac": 0.25, "exposed_wire_rows_per_step": exposed,
+         "hidden_wire_rows_per_step": 9000.0}
+    a.update(over)
+    return a
+
+
+def _ctrl_block(controller=12000.0, **over):
+    b = {"n": 20000, "graph": "ba", "k": 8, "km1": 9000,
+         "replica_budget": 1250, "sync_every": 4, "clean_pairs": 6,
+         "arms": {
+             "controller": _ctrl_arm(
+                 controller, resolved_schedule="ragged",
+                 replica_budget=900, sync_every_final=8, retunes=1),
+             "a2a_exact": _ctrl_arm(64000.0, exposed_comm_frac=1.0),
+             "ragged_exact": _ctrl_arm(50000.0, exposed_comm_frac=1.0),
+             "ragged_stale": _ctrl_arm(12700.0),
+             "replica_stale": _ctrl_arm(12700.0),
+         },
+         "note": "exposed wire rows per step is the asserted figure; "
+                 "CPU-mesh epoch speed is not the claim"}
+    b.update(over)
+    return b
+
+
+def test_validator_controller_ab_contract():
+    """The adaptive-controller block (PR-12): null needs a degradation
+    marker; the controller arm must be <= EVERY static arm on exposed
+    wire rows/step and STRICTLY below at least one; all five arms must be
+    present; the honest-measurement note is part of the contract — and
+    the checker fails on a synthetic violation (the satellite's
+    unit-test requirement)."""
+    from validate_bench import check_controller_ab
+
+    assert any("controller_ab_degraded" in e for e in check_controller_ab(
+        {"controller_ab_8dev": None}))
+    assert not check_controller_ab(
+        {"controller_ab_8dev": None, "controller_ab_degraded": "deadline"})
+    assert not check_controller_ab({"controller_ab_8dev": _ctrl_block()})
+    # synthetic violation: controller above a static arm
+    worse = _ctrl_block(controller=13000.0)
+    errs = check_controller_ab({"controller_ab_8dev": worse})
+    assert any("above static arm" in e for e in errs)
+    # universal tie is not a win
+    tie = _ctrl_block()
+    for nm in tie["arms"]:
+        tie["arms"][nm]["exposed_wire_rows_per_step"] = 500.0
+    assert any("STRICTLY" in e for e in check_controller_ab(
+        {"controller_ab_8dev": tie}))
+    assert any("missing arm" in e for e in check_controller_ab(
+        {"controller_ab_8dev": {"arms": {"controller": _ctrl_arm(1.0)}}}))
+    assert any("note" in e for e in check_controller_ab(
+        {"controller_ab_8dev": _ctrl_block(note="timings only")}))
+
+
 def _serve_arm(wire, **over):
     a = {"achieved_qps": 48.0, "latency_p50_ms": 4.0, "latency_p99_ms": 11.0,
          "queries": 200, "compiles": 2, "buckets": [8, 16],
@@ -295,7 +351,7 @@ def test_validator_cli_exit_codes(tmp_path):
     assert "violation" in r.stdout
 
 
-def _clean_analysis_report(n_modes=33):
+def _clean_analysis_report(n_modes=36):
     modes = {
         f"train/gcn/a2a/s0/m{i}": {
             "ok": True,
