@@ -339,6 +339,34 @@ def render(path: str, max_steps: int = 12) -> str:
                     f"{_fmt(ts.skew['busy_max_over_mean'], 4)}x mean busy "
                     "(per-device skew gauge)")
 
+    # ------------------------------------------------ resilience layer (v4)
+    ckpts, resumes = log.checkpoints(), log.resumes()
+    if ckpts or resumes:
+        lines.append(f"\nresilience: {len(ckpts)} checkpoint(s), "
+                     f"{len(resumes)} resume(s) (docs/resilience.md)")
+        for rv in resumes:
+            tag = []
+            if rv.get("fallback"):
+                tag.append("FELL BACK past corrupt newest: "
+                           + ", ".join(os.path.basename(s)
+                                       for s in rv.get("skipped", [])))
+            if rv.get("partial_state"):
+                tag.append("PARTIAL STATE (params-only)")
+            lines.append(
+                f"  resume @ step {int(rv['step'])} from "
+                f"{os.path.basename(rv['path'])}"
+                + (f"  [{'; '.join(tag)}]" if tag else ""))
+        if ckpts:
+            last = ckpts[-1]
+            saves = [c["wall_s"] for c in ckpts if c.get("wall_s")
+                     is not None]
+            lines.append(
+                f"  last checkpoint: step {int(last['step'])} → "
+                f"{os.path.basename(last['path'])}"
+                + (f" ({int(last['bytes'])} bytes)"
+                   if last.get("bytes") is not None else "")
+                + (f"; save wall_s " + _stats(saves) if saves else ""))
+
     serves = log.serves()
     if serves:
         lines.append(f"\nserve windows: {len(serves)} "
@@ -369,6 +397,15 @@ def render(path: str, max_steps: int = 12) -> str:
                     f"    compiles {int(sv['compiles'])} over buckets "
                     f"{sv.get('buckets')} — steady-state windows must "
                     "show 0 (the no-recompile contract)")
+            if sv.get("shed") is not None:
+                # deadline shedding (docs/resilience.md): overdue queries
+                # returned as explicit shed markers instead of silently
+                # blowing the published p99
+                lines.append(
+                    f"    shed {int(sv['shed'])} quer"
+                    f"{'y' if sv['shed'] == 1 else 'ies'} past "
+                    f"{_fmt(sv.get('shed_factor'))}× the latency budget "
+                    "before dispatch (explicit markers, not p99 outliers)")
             if sv.get("wire_rows_per_query") is not None:
                 lines.append(
                     f"    wire ({sv.get('comm_schedule', '?')} schedule): "

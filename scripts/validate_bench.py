@@ -72,6 +72,13 @@ What counts as a violation:
     contradicting its own violation lists, a red report committed as
     evidence, or a matrix shrunk below the supported floor are all
     hand-edit tells (``check_analysis_report``);
+  * **resume provenance** (PR-13, ``docs/resilience.md``): a parsed result
+    claiming a resume must name the checkpoint that seeded it — either the
+    trainer CLI's ``resumed: {step, path, fallback}`` block (its identity
+    fields validated), or a bare ``resumed: true`` flag WITH a
+    ``checkpoint_meta`` ``{step, version}`` block; any other ``resumed``
+    value is a violation anywhere, same rule as the ``measured`` flag
+    (the provenance flag may only assert a real resume);
   * **the pow2-k RB constraint** (``products_ksweep.json``): ``hp_rb``
     entries at non-power-of-two k, or k < 32.  The PR-2 review incident:
     ``partition_hypergraph_rb`` recurses on k/2 and the auto-select
@@ -198,6 +205,48 @@ def check_bench_record(rec: dict) -> list[str]:
             errs += check_controller_ab(parsed)
         if "serve_qps_8dev" in parsed:
             errs += check_serve_qps(parsed)
+    if isinstance(rec.get("parsed"), dict):
+        # flag integrity applies even to failed rounds (cf. `measured`)
+        errs += check_resume_provenance(rec["parsed"])
+    return errs
+
+
+def check_resume_provenance(parsed: dict) -> list[str]:
+    """The resume-provenance rule (module docstring): a resume claim must
+    name the checkpoint that seeded it, in one of the two shapes the repo
+    produces — the trainer CLI's ``resumed: {step, path, fallback}``
+    block (the report ``--resume auto`` emits, which IS the identity), or
+    a bare ``resumed: true`` flag accompanied by a ``checkpoint_meta``
+    ``{step, version}`` block.  Anything else is unverifiable."""
+    if "resumed" not in parsed:
+        return []
+    errs = []
+    res = parsed["resumed"]
+    if isinstance(res, dict):
+        # the trainer CLI's shape: the block itself names the checkpoint
+        if not (isinstance(res.get("step"), numbers.Integral)
+                and res["step"] >= 0
+                and isinstance(res.get("path"), str) and res["path"]):
+            errs.append(f"resumed block {res!r} missing its checkpoint "
+                        "identity ({step >= 0, path} — the trainer CLI's "
+                        "--resume auto shape, docs/resilience.md)")
+        return errs
+    if res is not True:
+        errs.append(f"resumed={res!r}: the provenance flag may only "
+                    "assert a real resume (true, or the trainer's "
+                    "{step, path, ...} block) — drop it or fix the "
+                    "generator")
+        return errs
+    meta = parsed.get("checkpoint_meta")
+    if not (isinstance(meta, dict)
+            and isinstance(meta.get("step"), numbers.Integral)
+            and meta["step"] >= 0
+            and isinstance(meta.get("version"), numbers.Integral)
+            and meta["version"] >= 1):
+        errs.append("resumed:true without a matching checkpoint_meta "
+                    "block ({step >= 0, version >= 1} at minimum) — a "
+                    "resume claim must name the checkpoint that seeded it "
+                    "(docs/resilience.md)")
     return errs
 
 

@@ -75,6 +75,13 @@ class RunRecorder:
                  run_kind: str = "train", argv: list | None = None):
         self.dir = outdir
         os.makedirs(outdir, exist_ok=True)
+        # sweep manifest temp litter from previous killed runs (one shared
+        # sweep policy, resilience.atomic): a RunRecorder is only ever
+        # constructed by the run directory's single writer (the
+        # coordinator), so anything matching here is from a dead process
+        from ..resilience.atomic import sweep_temp_litter
+
+        sweep_temp_litter(outdir, schema.MANIFEST_NAME)
         self.manifest: dict = {
             "v": schema.SCHEMA_VERSION,
             "ts": time.time(),
@@ -89,11 +96,14 @@ class RunRecorder:
     # ------------------------------------------------------------- manifest
     def _write_manifest(self) -> None:
         schema.validate_manifest(self.manifest)
-        path = os.path.join(self.dir, schema.MANIFEST_NAME)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(self.manifest, fh, indent=1)
-        os.replace(tmp, path)           # atomic: never a half-written manifest
+        # the ONE atomic-write helper (resilience.atomic): temp + fsync +
+        # rename — a kill during set_profile/set_plan leaves the previous
+        # manifest parseable, and the fsync makes the rewrite durable (the
+        # bare os.replace this used to do ordered metadata only)
+        from ..resilience.atomic import atomic_write_json
+
+        atomic_write_json(os.path.join(self.dir, schema.MANIFEST_NAME),
+                          self.manifest, indent=1)
 
     def set_plan(self, plan, partitioner: dict | None = None) -> None:
         """Record the comm plan's identity (and the partitioner provenance
@@ -210,6 +220,30 @@ class RunRecorder:
         ev.update({k: v for k, v in fields.items() if v is not None})
         self._emit(ev)
 
+    def record_checkpoint(self, step: int, path: str,
+                          wall_s: float | None = None,
+                          bytes: int | None = None) -> None:
+        """One COMMITTED durable checkpoint (schema v4,
+        ``resilience.runner``): emitted after the atomic rename, so this
+        event in the stream certifies the named file was fully on disk."""
+        ev = {"kind": "checkpoint", "step": int(step), "path": str(path)}
+        for k, val in (("wall_s", wall_s), ("bytes", bytes)):
+            if val is not None:
+                ev[k] = val
+        self._emit(ev)
+
+    def record_resume(self, step: int, path: str, fallback: bool = False,
+                      partial_state: bool = False,
+                      skipped: list | None = None) -> None:
+        """One restore (schema v4, the trainer CLI's ``--resume``):
+        ``fallback`` marks a corrupted-latest → previous-intact fallback,
+        ``partial_state`` a params-only restore of a pre-full-state file."""
+        ev = {"kind": "resume", "step": int(step), "path": str(path),
+              "fallback": bool(fallback), "partial_state": bool(partial_state)}
+        if skipped:
+            ev["skipped"] = [str(s) for s in skipped]
+        self._emit(ev)
+
     def record_heartbeat(self, event: str, **fields) -> None:
         self._emit({"kind": "heartbeat", "event": str(event),
                     "pid": os.getpid(), **fields})
@@ -281,6 +315,12 @@ class RunLog:
 
     def serves(self) -> list:
         return [e for e in self.events if e["kind"] == "serve"]
+
+    def checkpoints(self) -> list:
+        return [e for e in self.events if e["kind"] == "checkpoint"]
+
+    def resumes(self) -> list:
+        return [e for e in self.events if e["kind"] == "resume"]
 
 
 def load_run(path: str) -> RunLog:

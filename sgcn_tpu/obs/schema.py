@@ -36,6 +36,15 @@ Version history:
     engine (query count, achieved QPS, p50/p95/p99 latency, batching and
     compile counters, per-query wire-row gauge).  Purely additive again:
     v1/v2 streams load unchanged and must not carry the v3-only kind.
+  * **v4** — the resilience layer (``sgcn_tpu/resilience/``,
+    ``docs/resilience.md``): adds the ``checkpoint`` event kind (one
+    committed durable checkpoint: step, path, bytes, save wall time) and
+    the ``resume`` event kind (one restore: step, path, whether the
+    newest checkpoint was corrupt and fell back, whether the restore was
+    partial-state), plus the optional ``shed``/``shed_factor`` keys on
+    ``serve`` events (deadline-shed query count of the window — the
+    graceful-degradation counter of the micro-batcher).  Purely additive:
+    v1–v3 streams load unchanged and must not carry the v4-only kinds.
 """
 
 from __future__ import annotations
@@ -43,20 +52,23 @@ from __future__ import annotations
 import math
 import numbers
 
-SCHEMA_VERSION = 3
-SUPPORTED_VERSIONS = (1, 2, 3)
+SCHEMA_VERSION = 4
+SUPPORTED_VERSIONS = (1, 2, 3, 4)
 
 # event stream file names inside a run directory
 MANIFEST_NAME = "manifest.json"
 EVENTS_NAME = "events.jsonl"
 HEARTBEAT_NAME = "heartbeat.jsonl"
 
-EVENT_KINDS = ("step", "eval", "heartbeat", "summary", "span", "serve")
-# the span kind is a v2 addition and the serve kind a v3 one; a stream
-# claiming an older version must not carry a newer kind
+EVENT_KINDS = ("step", "eval", "heartbeat", "summary", "span", "serve",
+               "checkpoint", "resume")
+# the span kind is a v2 addition, the serve kind v3, checkpoint/resume v4;
+# a stream claiming an older version must not carry a newer kind
 _KINDS_BY_VERSION = {1: ("step", "eval", "heartbeat", "summary"),
                      2: ("step", "eval", "heartbeat", "summary", "span"),
-                     3: EVENT_KINDS}
+                     3: ("step", "eval", "heartbeat", "summary", "span",
+                         "serve"),
+                     4: EVENT_KINDS}
 
 _NUM = numbers.Real
 _STR = str
@@ -79,6 +91,14 @@ _REQUIRED = {
     "serve": {"queries": _NUM, "achieved_qps": _NUM,
               "latency_p50_ms": _NUM, "latency_p95_ms": _NUM,
               "latency_p99_ms": _NUM},
+    # v4: one committed durable checkpoint (resilience.runner) — emitted
+    # AFTER the atomic rename, so an event in the stream means the file
+    # named was fully on disk at that moment
+    "checkpoint": {"step": _NUM, "path": _STR},
+    # v4: one restore (trainer CLI --resume): ``fallback`` true when the
+    # newest checkpoint was corrupt and an older intact one was used;
+    # ``partial_state`` true when a pre-full-state file loaded params-only
+    "resume": {"step": _NUM, "path": _STR},
 }
 
 # kind -> {field: type} (optional, typed when present)
@@ -125,6 +145,20 @@ _OPTIONAL = {
         "comm_schedule": _STR,  # resolved transport of the forward
         "wire_rows_per_query": _NUM,   # analytic: L·wire_rows/exchange ÷
         #                                max_batch (plan-derived, zero-band)
+        # v4 additive: deadline shedding (docs/resilience.md): queries
+        # whose age already exceeded budget × shed_factor before dispatch
+        # were returned as shed markers instead of silently blowing p99
+        "shed": _NUM,
+        "shed_factor": _NUM,
+    },
+    "checkpoint": {
+        "bytes": _NUM,        # committed file size
+        "wall_s": _NUM,       # save duration (host clock around the write)
+    },
+    "resume": {
+        "fallback": bool,     # newest checkpoint corrupt, older one used
+        "partial_state": bool,  # pre-full-state file: params-only restore
+        "skipped": list,      # corrupt checkpoint paths passed over
     },
 }
 
@@ -291,12 +325,20 @@ def validate_event(ev: dict) -> None:
             raise ValueError(f"span event: negative dur_s={ev['dur_s']}")
         if "depth" in ev and ev["depth"] < 0:
             raise ValueError(f"span event: negative depth={ev['depth']}")
+    if kind == "checkpoint":
+        for f in ("step", "bytes", "wall_s"):
+            if f in ev and isinstance(ev[f], _NUM) and ev[f] < 0:
+                raise ValueError(
+                    f"checkpoint event: negative {f}={ev[f]}")
+    if kind == "resume":
+        if "step" in ev and isinstance(ev["step"], _NUM) and ev["step"] < 0:
+            raise ValueError(f"resume event: negative step={ev['step']}")
     if kind == "serve":
         for f in ("queries", "achieved_qps", "latency_p50_ms",
                   "latency_p95_ms", "latency_p99_ms", "window_s",
                   "offered_qps", "batches", "mean_batch",
                   "deadline_flushes", "full_flushes", "latency_budget_ms",
-                  "compiles", "wire_rows_per_query"):
+                  "compiles", "wire_rows_per_query", "shed", "shed_factor"):
             if f in ev and isinstance(ev[f], _NUM) and (
                     not math.isfinite(ev[f]) or ev[f] < 0):
                 raise ValueError(

@@ -35,11 +35,89 @@ virtual-device integration test (``tests/test_multihost.py``).  See
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 
 import jax
 
 from .mesh import AXIS, make_mesh_1d
+
+# rendezvous robustness (docs/resilience.md): how long ONE initialize
+# attempt may wait for all peers before it is declared stalled, and the
+# backoff before the single retry.  A transiently late peer (a host still
+# booting, a container being rescheduled) is routine on preemptible pods —
+# one retry absorbs it; a peer that misses BOTH attempts is genuinely gone
+# and the clear error beats an unbounded hang.
+RENDEZVOUS_TIMEOUT_S = 300.0
+RENDEZVOUS_BACKOFF_S = 5.0
+
+
+def _initialize_with_retry(heartbeat, detail: str, **kwargs) -> None:
+    """``jax.distributed.initialize`` under an explicit stalled-peer
+    timeout with ONE retry + backoff.  Heartbeats mark every transition
+    (start/stalled/retry/done/failed), so an operator watching the run
+    directory sees WHICH attempt is in flight — the stalled-vs-slow signal
+    the dryrun classifier reads."""
+    import inspect
+
+    timeout = float(os.environ.get("SGCN_RENDEZVOUS_TIMEOUT",
+                                   str(RENDEZVOUS_TIMEOUT_S)))
+    backoff = float(os.environ.get("SGCN_RENDEZVOUS_BACKOFF",
+                                   str(RENDEZVOUS_BACKOFF_S)))
+    try:
+        params = inspect.signature(jax.distributed.initialize).parameters
+        if "initialization_timeout" in params:
+            kwargs["initialization_timeout"] = int(timeout)
+    except (TypeError, ValueError):
+        pass                    # older jax: no per-attempt timeout knob
+    for attempt in (1, 2):
+        heartbeat("rendezvous:start", phase="init_distributed",
+                  detail=f"attempt {attempt}/2, {detail}, "
+                         f"timeout {timeout:.0f}s")
+        try:
+            jax.distributed.initialize(**kwargs)
+            heartbeat("rendezvous:done", phase="init_distributed",
+                      detail=f"attempt {attempt}/2")
+            return
+        except Exception as e:           # noqa: BLE001 — classified below
+            # classify before diagnosing: only a timeout-shaped failure is
+            # evidence of a STALLED peer — blaming a dead peer for a bad
+            # coordinator address / bound port / auth error sends the
+            # operator hunting in exactly the wrong place
+            text = str(e).lower()
+            stall_like = any(t in text for t in
+                             ("timed out", "timeout", "deadline",
+                              "unavailable"))
+            if attempt == 2:
+                heartbeat("rendezvous:failed", phase="init_distributed",
+                          detail=str(e)[-200:])
+                cause = (
+                    f"a peer stalled past the {timeout:.0f}s timeout on "
+                    "both attempts, or the coordinator is unreachable — "
+                    "check that every host in the job is up and can reach "
+                    f"{kwargs.get('coordinator_address') or 'the pod'} "
+                    "($SGCN_RENDEZVOUS_TIMEOUT / _BACKOFF tune the "
+                    "attempt budget)" if stall_like else
+                    "NOT a timeout — likely local configuration (bad "
+                    "coordinator address, port already bound, auth)")
+                raise RuntimeError(
+                    f"rendezvous failed twice ({detail}): {cause}; "
+                    f"underlying error: {e}") from e
+            heartbeat("rendezvous:stalled" if stall_like
+                      else "rendezvous:error",
+                      phase="init_distributed",
+                      detail=f"attempt 1 failed ({str(e)[-120:]}); "
+                             f"retrying in {backoff:.0f}s")
+            # a timed-out initialize leaves the distributed client SET
+            # (jax assigns global_state.client before connect()), and a
+            # second initialize then refuses with "should only be called
+            # once" — shut the half-initialized state down or the retry
+            # can never actually re-attempt the rendezvous
+            try:
+                jax.distributed.shutdown()
+            except Exception:           # noqa: BLE001 — nothing to shut down
+                pass
+            time.sleep(backoff)
 
 
 @dataclass
@@ -99,25 +177,23 @@ def init_distributed(coordinator: str | None = None,
     if num_processes is not None and num_processes > 1:
         # heartbeats bracket the rendezvous: a pod whose coordinator never
         # comes up looks IDENTICAL to a slow compile from the driver's seat
-        # — the last heartbeat's phase tells them apart (docs/observability.md)
-        heartbeat("rendezvous:start", phase="init_distributed",
-                  detail=f"{num_processes} processes @ {coordinator}")
-        jax.distributed.initialize(
+        # — the last heartbeat's phase tells them apart
+        # (docs/observability.md); a stalled peer times out per attempt
+        # and gets ONE retry + backoff before the clear failure
+        _initialize_with_retry(
+            heartbeat, f"{num_processes} processes @ {coordinator}",
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id,
         )
-        heartbeat("rendezvous:done", phase="init_distributed")
     elif num_processes is None:
         # Cloud TPU pod: fully autodetected — only when there genuinely are
         # multiple workers (single-worker boxes also set TPU_WORKER_HOSTNAMES)
         hosts = [h for h in os.environ.get(
             "TPU_WORKER_HOSTNAMES", "").split(",") if h]
         if len(hosts) > 1:
-            heartbeat("rendezvous:start", phase="init_distributed",
-                      detail=f"TPU pod autodetect, {len(hosts)} hosts")
-            jax.distributed.initialize()
-            heartbeat("rendezvous:done", phase="init_distributed")
+            _initialize_with_retry(
+                heartbeat, f"TPU pod autodetect, {len(hosts)} hosts")
     return DistributedContext(
         process_id=jax.process_index(),
         num_processes=jax.process_count(),

@@ -1,0 +1,108 @@
+"""The resumable training loop — steps, durable checkpoints, kill points.
+
+``run_resumable`` is the driver behind the trainer CLI's
+``--checkpoint-every N`` / ``--resume auto`` flags: it runs optimizer steps
+``start_step .. total_steps-1`` one dispatch at a time (the per-step
+granularity checkpointing needs — the fused multi-epoch program cannot stop
+mid-loop), saves a durable full-state checkpoint every ``checkpoint_every``
+steps through a ``CheckpointManager``, and calls the fault-injection kill
+point (``faults.after_checkpoint_save``) immediately after each committed
+save — which is exactly where a preemption that the checkpoint survives
+would land.
+
+The resume CONTRACT this loop upholds (pinned by
+``tests/test_resilience.py`` across the full mode matrix): for every
+supported mode family, *train s steps → checkpoint → new process → resume →
+train t−s steps* yields losses and params ``==`` (f32 bit-for-bit) the
+uninterrupted t-step run, with cumulative CommStats totals that reconcile
+across the seam.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from . import faults
+
+
+def save_and_record(manager, state_holder, step: int, recorder=None) -> str:
+    """The ONE durable-commit protocol both trainers share: atomic save
+    through the manager, the schema-v4 checkpoint event (emitted AFTER the
+    rename — the event certifies the file was on disk), then the
+    fault-injection kill point.  Returns the committed path."""
+    t0 = time.perf_counter()
+    path = manager.save(state_holder, step=step)
+    if recorder is not None:
+        recorder.record_checkpoint(
+            step=step, path=path,
+            wall_s=time.perf_counter() - t0,
+            bytes=os.path.getsize(path))
+    # the kill point: a fault-injected run dies HERE, after the save
+    # committed — the closest a test gets to a preemption
+    faults.after_checkpoint_save(path, step)
+    return path
+
+
+def run_resumable(trainer, data, total_steps: int, *, manager=None,
+                  checkpoint_every: int = 0, start_step: int = 0,
+                  verbose: bool = True) -> dict:
+    """Run steps ``start_step..total_steps-1``; returns the end-of-run
+    report (``CommStats.report()`` + ``steps``/``start_step``/``elapsed_s``
+    + the full-precision per-step ``losses`` list — resumed runs report
+    the steps THEY ran; the uninterrupted baseline's tail must match them
+    float-for-float)."""
+    if checkpoint_every < 0:
+        raise ValueError(f"checkpoint_every must be >= 0, "
+                         f"got {checkpoint_every}")
+    if checkpoint_every and manager is None:
+        raise ValueError("checkpoint_every > 0 needs a CheckpointManager")
+    if not 0 <= start_step <= total_steps:
+        raise ValueError(
+            f"start_step {start_step} outside [0, {total_steps}] — the "
+            "checkpoint is ahead of this run's schedule (asked for fewer "
+            "total steps than were already trained?)")
+    from ..parallel.mesh import shard_stacked
+
+    data = type(data)(**shard_stacked(trainer.mesh, vars(data)))
+    losses: list[float] = []
+    t0 = time.perf_counter()
+    for i in range(start_step, total_steps):
+        loss = float(trainer.step(data))
+        losses.append(loss)
+        done = i + 1
+        if verbose:
+            print(f"step {done}: loss {loss:.6f}", flush=True)
+        if manager is not None and checkpoint_every \
+                and done % checkpoint_every == 0:
+            save_and_record(manager, trainer, done,
+                            recorder=getattr(trainer, "recorder", None))
+    elapsed = time.perf_counter() - t0
+    report = trainer.stats.report()
+    steps_run = total_steps - start_step
+    report.update(
+        steps=total_steps,
+        start_step=start_step,
+        steps_run=steps_run,
+        elapsed_s=elapsed,
+        # deliberately NOT named epoch_s: fit()'s epoch_s excludes warmup
+        # and compile, while this loop's first step pays the XLA compile —
+        # publishing it under the same key would poison any cross-run
+        # epoch-time comparison (the honest-measurement discipline)
+        step_s_wall=elapsed / max(steps_run, 1),
+        losses=losses,
+    )
+    phases = trainer.timer.report()
+    if phases:
+        report["phases"] = phases
+    if trainer.loss_name == "bce" and trainer.last_err is not None:
+        # last_err is None on a zero-remaining-steps resume (the schedule
+        # was already complete; the loop body never ran)
+        report["err"] = float(trainer.last_err)
+    if getattr(trainer, "recorder", None) is not None:
+        # same end-of-run summary event fit() emits (loss lists excluded,
+        # mirroring fit's loss_history exclusion) — adding --checkpoint-dir
+        # must not silently drop the summary from the obs stream
+        trainer.recorder.record_summary(
+            {k: v for k, v in report.items() if k != "losses"})
+    return report

@@ -69,6 +69,13 @@ def main() -> None:
     p.add_argument("--latency-budget-ms", type=float, default=50.0,
                    help="micro-batcher deadline: flush once the oldest "
                         "pending query has waited this long")
+    p.add_argument("--shed-factor", type=float, default=None, metavar="F",
+                   help="deadline shedding (docs/resilience.md): a query "
+                        "whose age already exceeds latency-budget-ms × F "
+                        "at dispatch is returned as an explicit shed "
+                        "marker instead of silently blowing the p99; "
+                        "the shed count lands in the serve event (F >= 1; "
+                        "default: never shed)")
     p.add_argument("--max-batch", type=int, default=32)
     p.add_argument("--buckets", default=None,
                    help="comma-separated padded batch-size buckets to "
@@ -174,7 +181,7 @@ def main() -> None:
         comm_schedule=args.comm_schedule, halo_dtype=args.halo_dtype,
         checkpoint=args.checkpoint, max_batch=args.max_batch,
         buckets=buckets, latency_budget_ms=args.latency_budget_ms,
-        seed=args.seed)
+        shed_factor=args.shed_factor, seed=args.seed)
     engine.set_features(feats)
 
     recorder = None

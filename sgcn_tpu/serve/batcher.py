@@ -10,6 +10,15 @@ query waits):
     pending query has waited ``latency_budget_ms`` (age of the head of the
     queue, not the mean: the budget is a per-query promise).
 
+**Deadline shedding** (graceful degradation, ``docs/resilience.md``): with
+``shed_factor`` set, a flushed query whose age ALREADY exceeds
+``latency_budget_ms × shed_factor`` at dispatch time is returned as an
+explicit shed marker (``split_shed``) instead of being served — under
+overload the p99 of SERVED queries stays honest and the shed count becomes
+a first-class gauge (the v4 ``shed`` key of the serve event) rather than a
+silent latency blow-out.  ``None`` (default) never sheds — the pre-existing
+batcher exactly.
+
 Shapes under jit are static, so a variable-size batch would recompile the
 forward per distinct size — the engine instead pre-compiles a small ladder
 of padded ``buckets`` (doubling up to ``max_batch`` by default) and every
@@ -59,9 +68,13 @@ class MicroBatcher:
     latency_budget_ms: float = 50.0
     buckets: tuple = None
     clock: object = time.monotonic
+    # deadline shedding (module docstring): shed queries older than
+    # budget × shed_factor at dispatch; None = never shed
+    shed_factor: float | None = None
     # flush counters — the serve event's batching gauges
     full_flushes: int = 0
     deadline_flushes: int = 0
+    shed_count: int = 0
     _pending: list = field(default_factory=list)
 
     def __post_init__(self):
@@ -79,6 +92,11 @@ class MicroBatcher:
             raise ValueError(
                 f"latency_budget_ms must be >= 0, got "
                 f"{self.latency_budget_ms}")
+        if self.shed_factor is not None and self.shed_factor < 1:
+            raise ValueError(
+                f"shed_factor must be >= 1 (shedding below the deadline "
+                f"flush itself would drop queries the budget still "
+                f"covers), got {self.shed_factor}")
 
     def bucket_for(self, nqueries: int) -> int:
         """Smallest pre-compiled bucket covering ``nqueries``."""
@@ -121,6 +139,22 @@ class MicroBatcher:
         """Unconditional drain (end of a traffic window); ``None`` if empty.
         Not a deadline flush — counters stay untouched."""
         return self._take() if self._pending else None
+
+    def split_shed(self, batch, now: float | None = None):
+        """Partition a flushed batch into ``(dispatch, shed)`` at dispatch
+        time: queries whose age already exceeds
+        ``latency_budget_ms × shed_factor`` are shed — an explicit marker
+        the caller returns to the client instead of a silently late
+        result.  With ``shed_factor=None`` every query dispatches (the
+        pre-shedding behavior, counters untouched)."""
+        if self.shed_factor is None or not batch:
+            return batch, []
+        now = self.clock() if now is None else float(now)
+        cutoff = self.latency_budget_ms * self.shed_factor / 1e3
+        keep = [p for p in batch if now - p.t_arrival <= cutoff]
+        shed = [p for p in batch if now - p.t_arrival > cutoff]
+        self.shed_count += len(shed)
+        return keep, shed
 
     def __len__(self) -> int:
         return len(self._pending)

@@ -70,6 +70,7 @@ class ServeEngine:
         max_batch: int = 64,
         buckets: tuple | None = None,
         latency_budget_ms: float = 50.0,
+        shed_factor: float | None = None,
         seed: int = 0,
         precompile: bool = True,
     ):
@@ -99,7 +100,8 @@ class ServeEngine:
             max_batch=max_batch,
             latency_budget_ms=latency_budget_ms,
             buckets=buckets if buckets is not None
-            else default_buckets(max_batch))
+            else default_buckets(max_batch),
+            shed_factor=shed_factor)
         self.recorder = None
         self.timer = PhaseTimer()
         from ..obs.tracing import SpanTimer
@@ -338,4 +340,10 @@ class ServeEngine:
             buckets=list(self.batcher.buckets),
             comm_schedule=self.comm_schedule,
             wire_rows_per_query=g["wire_rows_per_query"],
+            # v4 additive: deadline-shed count of the window — present
+            # only when shedding is configured, so pre-shedding events
+            # keep their exact shape
+            shed=(getattr(result, "shed", 0)
+                  if self.batcher.shed_factor is not None else None),
+            shed_factor=self.batcher.shed_factor,
         )

@@ -47,12 +47,17 @@ def synthetic_query_ids(n: int, count: int, seed: int = 0,
 
 @dataclass
 class ServeResult:
-    """Measured outcome of one traffic window."""
+    """Measured outcome of one traffic window.  ``shed`` counts the
+    queries the batcher's deadline shedding returned as explicit markers
+    instead of serving (``MicroBatcher.split_shed``) — shed queries appear
+    in NO latency quantile: the published p50/p95/p99 describe served
+    queries only, which is the point of shedding."""
 
     latencies_ms: list = field(default_factory=list)
     window_s: float = 0.0
     batches: int = 0
     batch_sizes: list = field(default_factory=list)
+    shed: int = 0
 
     @property
     def queries(self) -> int:
@@ -85,7 +90,7 @@ class ServeResult:
         return self._pct(99)
 
     def summary(self) -> dict:
-        return {
+        out = {
             "queries": self.queries,
             "window_s": round(self.window_s, 6),
             "achieved_qps": round(self.achieved_qps, 3),
@@ -95,6 +100,9 @@ class ServeResult:
             "batches": self.batches,
             "mean_batch": round(self.mean_batch, 3),
         }
+        if self.shed:
+            out["shed"] = self.shed
+        return out
 
 
 def run_loadgen(engine, qids, offered_qps: float | None = None,
@@ -107,6 +115,13 @@ def run_loadgen(engine, qids, offered_qps: float | None = None,
     t0 = clock()
 
     def execute(batch):
+        if not batch:
+            return
+        # deadline shedding (batcher.split_shed): overdue queries become
+        # explicit shed markers — they are counted, never served, and
+        # never enter the latency quantiles
+        batch, shed = batcher.split_shed(batch, clock())
+        res.shed += len(shed)
         if not batch:
             return
         engine.query([p.qid for p in batch])

@@ -35,6 +35,56 @@ def _budget(text: str):
     return int(text)
 
 
+def _resume_auto(mgr, target, recorder):
+    """The ONE --resume auto sequence for both trainers: restore the
+    newest intact checkpoint into ``target``, surface the partial-state
+    flag the loader set, and emit the schema-v4 resume event.  Returns
+    ``(start_step, resumed_block)``."""
+    start_step, rpath, skipped = mgr.load_latest(target)
+    partial = getattr(target, "last_restore_partial", False)
+    resumed = {"step": start_step, "path": rpath,
+               "fallback": bool(skipped)}
+    if recorder is not None:
+        recorder.record_resume(step=start_step, path=rpath,
+                               fallback=bool(skipped),
+                               partial_state=partial,
+                               skipped=skipped or None)
+    return start_step, resumed
+
+
+def _fit_minibatch_durable(tr, feats, labels, args, mgr, recorder, ctx,
+                           start_ep: int = 0) -> dict:
+    """Mini-batch flavor of the durable path: fit in chunks of
+    ``--checkpoint-every`` EPOCHS (the mini-batch trainer's natural
+    checkpoint grain — its per-batch plans have no stable step identity),
+    saving the inner trainer's state after each chunk.  ``--warmup`` runs
+    only on a fresh start (warm-up steps are real optimizer steps; a
+    resumed run must not repeat them).  No bit-identity claim here — that
+    contract is the full-batch trainer's (docs/resilience.md)."""
+    from ..resilience.runner import save_and_record
+
+    every = args.checkpoint_every
+    total = args.epochs
+    history: list = []
+    warm = args.warmup if start_ep == 0 else 0
+    done, report = start_ep, None
+    while done < total:
+        run = total - done
+        if every:
+            run = min(run, every - done % every)
+        report = tr.fit(feats, labels, epochs=run, warmup=warm)
+        warm = 0
+        history += report.get("loss_history", [])
+        done += run
+        if every and done % every == 0 and ctx.is_coordinator:
+            save_and_record(mgr, tr.inner, done, recorder=recorder)
+    if report is None:
+        # resumed at (or past) the full schedule: nothing left to train
+        report = {"note": "resume found the epoch schedule complete"}
+    report.update(epochs=done, loss_history=history, start_epoch=start_ep)
+    return report
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description="sgcn_tpu distributed trainer")
     p.add_argument("-a", "--adjacency", default=None,
@@ -142,12 +192,32 @@ def main() -> None:
                         "planetoid split and report test accuracy for each")
     p.add_argument("--train-per-class", type=int, default=20,
                    help="planetoid split: train nodes per class")
-    p.add_argument("--resume", default=None, metavar="CKPT",
-                   help="restore params/opt_state from a checkpoint .npz "
-                        "before training (the reference re-randomizes every "
-                        "run, SURVEY.md §5.4 — this framework adds resume)")
+    p.add_argument("--resume", default=None, metavar="CKPT|auto",
+                   help="restore FULL trainer state (params/opt_state plus "
+                        "the stale/replica carries, sync counters, "
+                        "controller retunes and cumulative comm gauges — "
+                        "docs/resilience.md) from a checkpoint .npz before "
+                        "training; 'auto' picks the newest INTACT "
+                        "checkpoint in --checkpoint-dir, falling back past "
+                        "corrupt files with a logged warning, and trains "
+                        "only the REMAINING steps of the "
+                        "--warmup + --epochs schedule — bit-identical to "
+                        "the uninterrupted run for every supported mode")
     p.add_argument("--save-checkpoint", default=None, metavar="CKPT",
-                   help="save params/opt_state after training")
+                   help="save the full trainer state after training")
+    p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                   help="durable checkpoint directory "
+                        "(docs/resilience.md): step-stamped atomic "
+                        "checkpoints with keep-last-K rotation — the "
+                        "directory --resume auto restores from")
+    p.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
+                   help="write a durable full-state checkpoint into "
+                        "--checkpoint-dir every N optimizer steps "
+                        "(full-batch; for the mini-batch trainer N counts "
+                        "EPOCHS).  0 = off")
+    p.add_argument("--keep-checkpoints", type=int, default=3, metavar="K",
+                   help="rotation depth of --checkpoint-dir (keep the "
+                        "newest K checkpoints; default 3)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the training run "
                         "into DIR (view with TensorBoard / xprof; the "
@@ -227,6 +297,28 @@ def main() -> None:
             "--comm-schedule ragged: the accuracy-parity harness is "
             "defined for the default transport — drop the conflicting "
             "flag or use --comm-schedule auto")
+    if args.checkpoint_every < 0:
+        raise SystemExit(
+            f"--checkpoint-every must be >= 0, got {args.checkpoint_every}")
+    if (args.checkpoint_every or args.resume == "auto") \
+            and not args.checkpoint_dir:
+        raise SystemExit(
+            "--checkpoint-every / --resume auto operate on the durable "
+            "checkpoint directory; add --checkpoint-dir DIR "
+            "(docs/resilience.md)")
+    if args.checkpoint_dir and args.experiment == "accuracy":
+        raise SystemExit(
+            "--experiment accuracy trains fresh oracle+partitioned pairs; "
+            "durable checkpointing (--checkpoint-dir) is not supported "
+            "there")
+    if (args.checkpoint_dir and args.batch_size is not None
+            and args.resume and args.resume != "auto"):
+        raise SystemExit(
+            "mini-batch: explicit --resume CKPT does not compose with "
+            "--checkpoint-dir (the durable stamps count EPOCHS of THIS "
+            "schedule and would collide with the chained run's) — resume "
+            "the durable directory with --resume auto, or drop "
+            "--checkpoint-dir for a chained run")
 
     if args.metrics_out:
         # before any heavy import: heartbeat() in the launch/backend layers
@@ -349,6 +441,16 @@ def main() -> None:
             print(json.dumps(report), flush=True)
         return
 
+    # durable checkpointing (docs/resilience.md): one manager per run
+    # directory; saves are coordinator-only (multi-host ranks share the
+    # filesystem), restores run on every rank
+    mgr = None
+    if args.checkpoint_dir:
+        from ..resilience.checkpoint import CheckpointManager
+        mgr = CheckpointManager(args.checkpoint_dir,
+                                keep_last=args.keep_checkpoints)
+    resumed = None
+
     with prof:
         if args.batch_size is not None:
             tr = MiniBatchTrainer(a, pv, k, fin=f, widths=widths,
@@ -362,11 +464,19 @@ def main() -> None:
                 tr.attach_recorder(recorder)
             state = tr.inner          # checkpointable params/opt_state holder
             start_step = 0
-            if args.resume:
+            if args.resume == "auto":
+                # mini-batch checkpoints count EPOCHS completed
+                start_step, resumed = _resume_auto(mgr, state, recorder)
+            elif args.resume:
                 from ..utils.checkpoint import load_checkpoint
                 start_step = load_checkpoint(state, args.resume)
-            report = tr.fit(feats, labels, epochs=args.epochs,
-                            warmup=args.warmup)
+            if mgr is not None:
+                report = _fit_minibatch_durable(
+                    tr, feats, labels, args, mgr, recorder, ctx,
+                    start_ep=start_step if args.resume == "auto" else 0)
+            else:
+                report = tr.fit(feats, labels, epochs=args.epochs,
+                                warmup=args.warmup)
         else:
             plan = build_comm_plan(a, pv, k)
             tr = FullBatchTrainer(plan, fin=f, widths=widths, lr=args.lr,
@@ -387,11 +497,38 @@ def main() -> None:
                 tr.attach_recorder(recorder)
             state = tr
             start_step = 0
-            if args.resume:
+            if args.resume == "auto":
+                start_step, resumed = _resume_auto(mgr, tr, recorder)
+            elif args.resume:
                 from ..utils.checkpoint import load_checkpoint
                 start_step = load_checkpoint(state, args.resume)
             data = make_train_data(plan, feats, labels)
-            report = tr.fit(data, epochs=args.epochs, warmup=args.warmup)
+            if mgr is not None:
+                # the resumable per-step loop: durable checkpoints every N
+                # steps + the fault-injection kill point.  --resume auto:
+                # --warmup/--epochs name the run's TOTAL step schedule and
+                # the resumed process completes the remainder (bit-identity
+                # contract, docs/resilience.md).  Explicit --resume CKPT
+                # keeps its chained semantics (train warmup+epochs MORE
+                # steps) but threads the loaded step through, so the
+                # durable stamps continue the trainer's real step count
+                # instead of restarting at 1
+                from ..resilience.runner import run_resumable
+                save_mgr = mgr if ctx.is_coordinator else None
+                total = args.warmup + args.epochs
+                if args.resume and args.resume != "auto":
+                    total += start_step
+                report = run_resumable(
+                    tr, data, total,
+                    manager=save_mgr,
+                    checkpoint_every=(args.checkpoint_every
+                                      if save_mgr is not None else 0),
+                    start_step=(start_step if args.resume else 0))
+            else:
+                report = tr.fit(data, epochs=args.epochs,
+                                warmup=args.warmup)
+    if resumed is not None:
+        report["resumed"] = resumed
     if recorder is not None and args.profile:
         # --profile and --metrics-out compose: the jax.profiler trace is
         # flushed when the `with prof:` context above exits, so NOW the
@@ -405,9 +542,21 @@ def main() -> None:
         # count toward the saved step — chained --resume runs would otherwise
         # silently accumulate unreported parameter updates.
         from ..utils.checkpoint import save_checkpoint
+        if args.batch_size is not None and mgr is not None:
+            # the mini-batch DURABLE path stamps at EPOCH grain everywhere
+            # (the CheckpointManager files count epochs) — the final stamp
+            # must agree with them whether or not this run resumed, or two
+            # bit-identical end states would carry different step stamps
+            final_step = args.epochs
+        elif args.resume == "auto":
+            # --resume auto completes a FIXED total schedule (the durable
+            # path's bit-identity contract): the final step is absolute,
+            # not additive
+            final_step = args.epochs + args.warmup
+        else:
+            final_step = start_step + args.epochs + args.warmup
         report["checkpoint"] = save_checkpoint(
-            state, args.save_checkpoint,
-            step=start_step + args.epochs + args.warmup)
+            state, args.save_checkpoint, step=final_step)
 
     # rank-0-style end-of-run line (GPU/PGCN.py:226-238)
     report["backend"] = args.backend

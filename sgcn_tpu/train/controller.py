@@ -94,3 +94,24 @@ class CommController:
             "sync_every": int(self.sync_every),
             "retunes": list(self.decisions),
         }
+
+    # ----------------------------------------------------- checkpoint state
+    def state(self) -> dict:
+        """JSON-able resume state (docs/resilience.md): the EFFECTIVE
+        interval plus the retune log.  Without this a resumed run would
+        restart at the CLI's ``--sync-every`` and silently discard every
+        retune the controller already paid drift observations for."""
+        return {
+            "sync_every": int(self.sync_every),
+            "initial_sync_every": int(self.initial_sync_every),
+            "decisions": list(self.decisions),
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Restore ``state()`` — the retune log keeps accumulating across
+        the resume seam, so the manifest's controller block stays the full
+        history of the logical run."""
+        self.sync_every = int(state["sync_every"])
+        self.initial_sync_every = int(state.get("initial_sync_every",
+                                                self.initial_sync_every))
+        self.decisions = list(state.get("decisions", []))

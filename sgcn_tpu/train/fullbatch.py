@@ -1657,6 +1657,99 @@ class FullBatchTrainer:
                                 for sd in rr_sizes]
         return out
 
+    # ------------------------------------------------- checkpoint/resume state
+    # The carry attribute (at most one exists) whose leaves a full-state
+    # checkpoint must persist: the stale-halo carry subsumes the replica
+    # tables under the composed mode, so the two are mutually exclusive.
+    def _carry_attr(self) -> str | None:
+        if self.halo_staleness:
+            return "halo_carry"
+        if self.replica_budget:
+            return "replica_carry"
+        return None
+
+    def resume_state(self) -> tuple[dict, list]:
+        """``(state, carry_leaves)`` — everything beyond (params, opt_state)
+        a bit-identical resume needs (``docs/resilience.md``):
+
+          * the step counters that drive the sync/refresh SCHEDULE
+            (``_stale_step_idx``/``_rep_step_idx`` and their last-sync
+            anchors) — without them a resumed stale run re-runs the
+            initializing full-sync and diverges from the uninterrupted
+            trajectory on the very first step;
+          * the EFFECTIVE ``sync_every`` plus the controller's retune log
+            (a mid-run retune is algorithmic state, not configuration);
+          * the cumulative CommStats gauges, so the end-of-run comm report
+            reconciles across the seam;
+          * the stale/replica carry leaves (host copies, f32) — the
+            PipeGCN/CaPGNN algorithmic state itself.
+
+        ``state`` is JSON-able; ``carry_leaves`` is a flat list of numpy
+        arrays in ``jax.tree`` order for the live carry structure."""
+        state: dict = {
+            "step_count": int(self._step_count),
+            "sync_every": int(self.sync_every),
+            "comm_stats": self.stats.state(),
+        }
+        if self.halo_staleness:
+            state["stale_step_idx"] = int(self._stale_step_idx)
+            state["last_sync_idx"] = int(self._last_sync_idx)
+        if self.replica_budget and not self.halo_staleness:
+            state["rep_step_idx"] = int(self._rep_step_idx)
+            state["last_refresh_idx"] = int(self._last_refresh_idx)
+        if self.controller is not None:
+            state["controller"] = self.controller.state()
+        carry_leaves: list = []
+        attr = self._carry_attr()
+        if attr is not None:
+            live = jax.tree.leaves(getattr(self, attr))
+            if any(not getattr(x, "is_fully_addressable", True)
+                   for x in live):
+                # multi-process mesh: the carry is P(AXIS)-sharded across
+                # hosts, so the coordinator cannot fetch it — fail with
+                # the repo's standard clean deferral instead of the
+                # cryptic non-addressable-devices RuntimeError np.asarray
+                # would raise mid-save (params/opt_state are replicated
+                # and stay checkpointable; exact mode is unaffected)
+                raise ValueError(
+                    "full-state checkpointing of the stale/replica carry "
+                    "is single-process for now: the carry is sharded "
+                    "across hosts and the coordinator cannot fetch it — "
+                    "run exact mode for multi-host durable checkpoints, "
+                    "or checkpoint carried modes from a single-process "
+                    "run (docs/resilience.md)")
+            state["carry"] = attr
+            carry_leaves = [np.asarray(x) for x in live]
+            state["n_carry"] = len(carry_leaves)
+        return state, carry_leaves
+
+    def restore_resume_state(self, state: dict, carry_leaves=None) -> None:
+        """Restore ``resume_state()`` output onto a trainer built with the
+        SAME flags (plan, mode levers, widths) — the checkpoint loader
+        validates shape/mode agreement and raises clear errors before
+        calling this; here the carry is re-sharded exactly like its
+        zero-init was."""
+        self._step_count = int(state.get("step_count", 0))
+        if "sync_every" in state:
+            self.sync_every = int(state["sync_every"])
+        if self.halo_staleness:
+            self._stale_step_idx = int(state.get("stale_step_idx", 0))
+            self._last_sync_idx = int(state.get("last_sync_idx", 0))
+        if self.replica_budget and not self.halo_staleness:
+            self._rep_step_idx = int(state.get("rep_step_idx", 0))
+            self._last_refresh_idx = int(state.get("last_refresh_idx", 0))
+        if self.controller is not None and state.get("controller"):
+            self.controller.load_state(state["controller"])
+            self.comm_decision["controller"] = self.controller.log()
+        if state.get("comm_stats"):
+            self.stats.load_state(state["comm_stats"])
+        attr = self._carry_attr()
+        if attr is not None and carry_leaves:
+            live = getattr(self, attr)
+            treedef = jax.tree.structure(live)
+            carry = jax.tree.unflatten(treedef, list(carry_leaves))
+            setattr(self, attr, shard_stacked(self.mesh, carry))
+
     # ------------------------------------------------------------------- api
     def step(self, data: TrainData, sync: bool = True):
         """One training step.  ``sync=True`` (default) blocks on the loss

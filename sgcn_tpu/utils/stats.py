@@ -242,6 +242,28 @@ class CommStats:
         self.exchanges += nlayers
         self._accumulate_bytes(1, 0)
 
+    # ----------------------------------------------------- checkpoint state
+    # the CUMULATIVE counters a resume must carry over so the end-of-run
+    # comm report of a resumed run reconciles exactly with the
+    # uninterrupted one (docs/resilience.md).  Per-exchange figures are NOT
+    # here: they are plan-derived and rebuilt by from_plan on every start.
+    _CUMULATIVE_ATTRS = (
+        "exchanges", "hidden_exchanges", "replica_exchanges",
+        "hidden_replica_exchanges", "halo_bytes_true_total",
+        "halo_bytes_wire_total", "partial_refresh_steps",
+        "partial_refresh_rows_total", "partial_refresh_wire_rows_total")
+
+    def state(self) -> dict:
+        """JSON-able snapshot of the cumulative gauges."""
+        return {a: int(getattr(self, a)) for a in self._CUMULATIVE_ATTRS}
+
+    def load_state(self, state: dict) -> None:
+        """Restore ``state()`` onto a freshly-built counter (``from_plan``
+        + ``set_replica`` already re-derived the per-exchange figures)."""
+        for a in self._CUMULATIVE_ATTRS:
+            if a in state:
+                setattr(self, a, int(state[a]))
+
     def cumulative(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Per-rank cumulative (send_vol, send_msgs, recv_vol, recv_msgs).
         Replica-booked exchanges (``count_step(replica=True)``) advance at

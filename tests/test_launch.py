@@ -43,3 +43,79 @@ def test_slurm_rendezvous_absent(monkeypatch):
                 "SGCN_COORDINATOR"):
         monkeypatch.delenv(var, raising=False)
     assert slurm_rendezvous_env() is None
+
+
+def test_rendezvous_retries_once_with_backoff(monkeypatch):
+    """PR-13 stalled-peer handling: one initialize timeout gets ONE retry
+    after a backoff (heartbeats marking stalled/retry), a second failure
+    raises the clear stalled-peer error — never an unbounded hang, never
+    an uninterpretable stack from deep inside the rendezvous."""
+    from sgcn_tpu.parallel import launch
+
+    monkeypatch.setenv("SGCN_RENDEZVOUS_BACKOFF", "0")
+    monkeypatch.setenv("SGCN_RENDEZVOUS_TIMEOUT", "7")
+    calls, beats, naps, downs = [], [], [], []
+    monkeypatch.setattr(launch.time, "sleep", lambda s: naps.append(s))
+    # a timed-out initialize leaves jax's distributed client set; the
+    # retry must shut it down or the second initialize refuses outright
+    monkeypatch.setattr(launch.jax.distributed, "shutdown",
+                        lambda: downs.append(1))
+
+    def hb(event, **fields):
+        beats.append((event, fields.get("detail", "")))
+
+    # transient peer: first attempt times out, retry succeeds
+    def flaky_init(**kw):
+        calls.append(kw)
+        if len(calls) == 1:
+            raise RuntimeError("Barrier timed out: peer 3 never arrived")
+
+    monkeypatch.setattr(launch.jax.distributed, "initialize", flaky_init)
+    launch._initialize_with_retry(hb, "2 processes @ node0:1234",
+                                  coordinator_address="node0:1234",
+                                  num_processes=2, process_id=0)
+    assert len(calls) == 2 and len(naps) == 1 and len(downs) == 1
+    events = [e for e, _ in beats]
+    assert events == ["rendezvous:start", "rendezvous:stalled",
+                      "rendezvous:start", "rendezvous:done"]
+    # the per-attempt timeout knob reaches jax when its API has one
+    import inspect
+    if "initialization_timeout" in inspect.signature(
+            launch.jax.distributed.initialize).parameters:
+        assert calls[0].get("initialization_timeout") == 7
+
+    # dead peer: both attempts fail → the clear stalled-peer error
+    calls.clear(), beats.clear()
+
+    def dead_init(**kw):
+        calls.append(kw)
+        raise RuntimeError("Barrier timed out")
+
+    monkeypatch.setattr(launch.jax.distributed, "initialize", dead_init)
+    try:
+        launch._initialize_with_retry(hb, "2 processes @ node0:1234",
+                                      coordinator_address="node0:1234",
+                                      num_processes=2, process_id=0)
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError as e:
+        assert "stalled" in str(e) and "node0:1234" in str(e)
+    assert len(calls) == 2
+    assert [e for e, _ in beats][-1] == "rendezvous:failed"
+
+    # non-timeout failure: retried, but NOT misdiagnosed as a stalled peer
+    calls.clear(), beats.clear()
+
+    def misconfig_init(**kw):
+        calls.append(kw)
+        raise RuntimeError("address already in use")
+
+    monkeypatch.setattr(launch.jax.distributed, "initialize",
+                        misconfig_init)
+    try:
+        launch._initialize_with_retry(hb, "2 processes @ node0:1234",
+                                      coordinator_address="node0:1234",
+                                      num_processes=2, process_id=0)
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError as e:
+        assert "NOT a timeout" in str(e) and "stalled" not in str(e)
+    assert [e for e, _ in beats][1] == "rendezvous:error"

@@ -187,6 +187,51 @@ def test_schema_validates_and_rejects():
         validate_event(bad)
 
 
+def test_schema_v4_checkpoint_resume_events():
+    """PR-13 resilience kinds: checkpoint/resume validate under v4, are
+    rejected for older stream versions (a v3 stream must not carry them),
+    and the serve ``shed`` key is typed + non-negative when present."""
+    import pytest
+
+    from sgcn_tpu.obs import SCHEMA_VERSION, validate_event
+
+    ck = {"v": SCHEMA_VERSION, "ts": 1.0, "kind": "checkpoint", "step": 4,
+          "path": "/runs/ckpt_00000004.npz", "bytes": 1234, "wall_s": 0.1}
+    validate_event(ck)
+    rs = {"v": SCHEMA_VERSION, "ts": 1.0, "kind": "resume", "step": 2,
+          "path": "/runs/ckpt_00000002.npz", "fallback": True,
+          "skipped": ["/runs/ckpt_00000004.npz"]}
+    validate_event(rs)
+    with pytest.raises(ValueError, match="kind"):
+        validate_event({**ck, "v": 3})      # v3 stream may not carry v4 kind
+    with pytest.raises(ValueError, match="missing required"):
+        validate_event({"v": SCHEMA_VERSION, "ts": 1.0, "kind": "checkpoint",
+                        "step": 4})
+    with pytest.raises(ValueError, match="negative"):
+        validate_event({**ck, "bytes": -1})
+    sv = {"v": SCHEMA_VERSION, "ts": 1.0, "kind": "serve", "queries": 10,
+          "achieved_qps": 5.0, "latency_p50_ms": 1.0, "latency_p95_ms": 2.0,
+          "latency_p99_ms": 3.0, "shed": 2, "shed_factor": 2.0}
+    validate_event(sv)
+    with pytest.raises(ValueError, match="shed"):
+        validate_event({**sv, "shed": -1})
+
+
+def test_recorder_checkpoint_resume_roundtrip(tmp_path):
+    from sgcn_tpu.obs import RunRecorder, load_run
+
+    d = str(tmp_path / "run")
+    with RunRecorder(d, config={}, run_kind="train") as rec:
+        rec.record_checkpoint(step=2, path="/x/ckpt_00000002.npz",
+                              wall_s=0.05, bytes=100)
+        rec.record_resume(step=2, path="/x/ckpt_00000002.npz",
+                          fallback=True, skipped=["/x/ckpt_00000004.npz"])
+    log = load_run(d)
+    assert [e["kind"] for e in log.events] == ["checkpoint", "resume"]
+    assert log.checkpoints()[0]["bytes"] == 100
+    assert log.resumes()[0]["fallback"] is True
+
+
 def test_recorder_roundtrip(tmp_path):
     from sgcn_tpu.obs import RunRecorder, load_run
 

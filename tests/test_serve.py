@@ -275,6 +275,61 @@ def test_loadgen_closed_loop_tail_drains_immediately():
     assert max(res.latencies_ms) == pytest.approx(1.0)
 
 
+def test_batcher_shed_split_is_explicit_and_counted():
+    """Deadline shedding (PR-13, docs/resilience.md): a flushed query whose
+    age already exceeds budget × shed_factor at dispatch is returned as an
+    explicit shed marker — never served, never a silent p99 outlier."""
+    now = [0.0]
+    b = MicroBatcher(max_batch=4, latency_budget_ms=100.0, buckets=(4,),
+                     clock=lambda: now[0], shed_factor=2.0)
+    b.submit(1, t_arrival=0.0)          # will be 0.25 s old: past 2×budget
+    b.submit(2, t_arrival=0.2)          # 0.05 s old: within budget
+    now[0] = 0.25
+    keep, shed = b.split_shed(b.flush())
+    assert [p.qid for p in keep] == [2]
+    assert [p.qid for p in shed] == [1]
+    assert b.shed_count == 1
+    # no shed_factor → pre-existing behavior: everything dispatches
+    b2 = MicroBatcher(max_batch=4, latency_budget_ms=100.0, buckets=(4,),
+                      clock=lambda: now[0])
+    b2.submit(1, t_arrival=0.0)
+    keep, shed = b2.split_shed(b2.flush())
+    assert [p.qid for p in keep] == [1] and shed == []
+    assert b2.shed_count == 0
+    # shedding below the deadline flush itself is rejected loudly
+    with pytest.raises(ValueError, match="shed_factor"):
+        MicroBatcher(max_batch=4, buckets=(4,), shed_factor=0.5)
+
+
+def test_loadgen_sheds_overdue_queries_out_of_quantiles():
+    """The loadgen path: shed queries are counted in ``ServeResult.shed``
+    (and the serve-event ``shed`` key) but excluded from the served count
+    and every latency quantile — under overload the published p99
+    describes queries that were actually answered."""
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    def sleep(dt):
+        now[0] += dt
+
+    # service time far above the arrival spacing: an open-loop overload.
+    # budget 10 ms, shed_factor 2 → anything older than 20 ms at dispatch
+    # sheds instead of blowing the tail.
+    b = MicroBatcher(max_batch=2, latency_budget_ms=10.0, buckets=(2,),
+                     clock=clock, shed_factor=2.0)
+    eng = _FakeEngine(b, now, service_s=0.1)
+    res = run_loadgen(eng, np.arange(6), offered_qps=1000.0,
+                      clock=clock, sleep=sleep)
+    assert res.shed > 0
+    assert res.queries + res.shed == 6
+    # every SERVED latency beat the shed cutoff at its dispatch; the shed
+    # ones would have been >= 20 ms and appear in no quantile
+    assert res.queries == len(res.latencies_ms)
+    assert res.summary()["shed"] == res.shed
+
+
 def test_synthetic_query_ids_range_and_skew():
     q = synthetic_query_ids(100, 500, seed=1)
     assert q.min() >= 0 and q.max() < 100

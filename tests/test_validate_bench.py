@@ -33,6 +33,37 @@ def test_validator_catches_null_value_without_marker():
     assert not check_bench_record(rec)
 
 
+def test_validator_resume_provenance_rule():
+    """PR-13: a parsed result claiming ``resumed: true`` must name the
+    checkpoint that seeded it (step + format version); a present-but-
+    untrue flag is a violation anywhere (the ``measured``-flag rule)."""
+    from validate_bench import check_resume_provenance
+
+    assert not check_resume_provenance({"metric": "m", "value": 1.0})
+    # the trainer CLI's own shape: the resumed block IS the identity
+    cli = {"metric": "m", "value": 1.0,
+           "resumed": {"step": 4, "path": "/ck/ckpt_00000004.npz",
+                       "fallback": False}}
+    assert not check_resume_provenance(cli)
+    cli["resumed"] = {"fallback": True}           # identity fields missing
+    assert any("identity" in e for e in check_resume_provenance(cli))
+    bare = {"metric": "m", "value": 1.0, "resumed": True}
+    assert any("checkpoint_meta" in e for e in check_resume_provenance(bare))
+    bare["checkpoint_meta"] = {"step": 4}          # missing version
+    assert any("checkpoint_meta" in e for e in check_resume_provenance(bare))
+    bare["checkpoint_meta"] = {"step": 4, "version": 2}
+    assert not check_resume_provenance(bare)
+    lied = {"metric": "m", "value": 1.0, "resumed": "yes"}
+    assert any("provenance flag" in e for e in check_resume_provenance(lied))
+    # rides check_bench_record for driver records (rc-independent flag
+    # integrity, meta requirement on claims)
+    rec = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+           "parsed": {"metric": "m", "value": 1.0, "resumed": True}}
+    assert any("checkpoint_meta" in e for e in check_bench_record(rec))
+    rec["parsed"]["checkpoint_meta"] = {"step": 4, "version": 2}
+    assert not check_bench_record(rec)
+
+
 def test_validator_catches_impossible_measurement_block():
     rec = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
            "parsed": {"metric": "m", "value": 1.0, "unit": "s",
