@@ -1349,6 +1349,214 @@ def bench_serve_qps_child(ahat, feats, labels, widths, graph: str,
     return out
 
 
+def bench_serve_subgraph(n: int, avg_deg: int, f: int, widths,
+                         graph: str = "ba"):
+    """Full-forward vs sub-graph serving A/B on the 8-virtual-device CPU
+    mesh (the ``serve_subgraph_ab_8dev`` block): shared open-loop traffic
+    against the hp partition through a ``mode='full'`` engine and a
+    ``mode='subgraph'`` engine, asserting the ≥10× per-query
+    FLOP/touched-row cut on the ANALYTIC gauges (docs/serving.md phase 2).
+
+    ``avg_deg`` is capped at the CORA-LIKE sparsity the acceptance claim
+    names (avg degree ~4): the receptive-set size — and therefore the cut
+    — is a property of the graph's density, not of the engine (measured on
+    the BA family at n=20000: deg 4 cuts rows/query ~41×, deg 10 only
+    ~10× because hub 2-hop neighborhoods swallow the graph).  The block
+    reports both arms' analytic figures either way, so a future denser-
+    graph round is a new trend series, not a hidden regression.  Degrades
+    to a marked partial block on failure."""
+    avg_deg = min(int(avg_deg), 4)
+    block: dict = {"serve_subgraph_ab_8dev": None}
+    try:
+        child = _run_vdev_child(n, avg_deg, f, widths, 2, graph,
+                                extra_args=("--serve-subgraph-ab-child",))
+        child.pop("metric", None)
+        child.pop("value", None)
+        block["serve_subgraph_ab_8dev"] = child
+        return block
+    except subprocess.TimeoutExpired:
+        print("# serve subgraph A/B exceeded its deadline", file=sys.stderr)
+        block["serve_subgraph_degraded"] = "deadline"
+        return block
+    except Exception as e:                      # noqa: BLE001 — diagnostic path
+        print(f"# serve subgraph A/B failed: {e!r}", file=sys.stderr)
+        block["serve_subgraph_degraded"] = repr(e)[:200]
+        return block
+
+
+def bench_serve_subgraph_child(ahat, feats, labels, widths, graph: str,
+                               offered_qps: float = 50.0,
+                               latency_budget_ms: float = 100.0,
+                               max_batch: int = 16,
+                               queries: int = 200) -> dict:
+    """One-process full-vs-subgraph serving A/B (the
+    ``--serve-subgraph-ab-child`` body): the SAME hp-partitioned plan,
+    features and open-loop query trace served through the PR-8 full-forward
+    engine and the sub-graph engine, both with double-buffered dispatch.
+
+    The asserted figures are the ANALYTIC per-query gauges: at cora-like
+    query rates a full forward computes ``k·B`` rows per micro-batch while
+    the sub-graph program touches only the routed queries' L-hop receptive
+    sets — both the touched-row and the FLOP per-query cut must be ≥10×
+    (re-checked by ``scripts/validate_bench.py::check_serve_subgraph_ab``).
+    CPU-mesh latency/QPS are measured live and reported honestly — never
+    the cross-arm claim (the host-side receptive-set packing is the
+    sub-graph arm's dominant cost on a no-ICI mesh; the FLOP bill is the
+    TPU-relevant figure)."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from sgcn_tpu.parallel import build_comm_plan
+    from sgcn_tpu.partition import partition_hypergraph_colnet
+    from sgcn_tpu.serve import ServeEngine, run_loadgen, synthetic_query_ids
+
+    k = len(jax.devices())
+    n = ahat.shape[0]
+    if k > 1:
+        pv, km1 = partition_hypergraph_colnet(ahat, k, seed=0)
+    else:
+        pv, km1 = np.zeros(n, dtype=np.int64), 0
+    plan = build_comm_plan(ahat, pv, k)
+    qids = synthetic_query_ids(n, queries, seed=0)
+    out: dict = {
+        "n": n, "graph": graph, "k": k, "km1": int(km1),
+        "nnz": int(ahat.nnz), "nlayers": len(widths),
+        "schedule": "a2a",
+        "offered_qps": offered_qps,
+        "latency_budget_ms": latency_budget_ms,
+        "max_batch": max_batch,
+        "measured": True,
+        "weights": "random-init",
+        "arms": {},
+        "note": "CPU-mesh latency/QPS are measured live and reported "
+                "honestly but are NOT the cross-arm claim (no ICI; the "
+                "sub-graph arm's receptive-set packing is host overhead "
+                "here) — the asserted figures are the ANALYTIC per-query "
+                "gauges: touched rows/query and FLOPs/query must both cut "
+                ">=10x vs the full forward at this query rate",
+    }
+    from sgcn_tpu.obs.tracing import scoped_span
+    gauges = {}
+    for arm, mode in (("full", "full"), ("subgraph", "subgraph")):
+        eng = ServeEngine(plan, fin=feats.shape[1], widths=widths,
+                          comm_schedule="a2a", max_batch=max_batch,
+                          latency_budget_ms=latency_budget_ms, seed=0,
+                          mode=mode)
+        eng.set_features(feats)
+        eng.warmup(qids)     # every bucket, outside the measured window
+        # the sub-graph arm's shape keys depend on the TRAFFIC's receptive
+        # sets, not just the query-count buckets — one unmeasured pass over
+        # the same open-loop trace warms them so the measured window's
+        # latency describes serving, not compilation (the PR-8 warmup
+        # lesson, extended to the receptive-size ladder)
+        run_loadgen(eng, qids, offered_qps=offered_qps, concurrent=True)
+        eng.batcher.deadline_flushes = 0
+        eng.batcher.full_flushes = 0
+        with scoped_span(f"bench:serve_subgraph:{arm}",
+                         phase="serve_subgraph_child",
+                         detail=f"n={n} graph={graph}"):
+            res = run_loadgen(eng, qids, offered_qps=offered_qps,
+                              concurrent=True)
+        g = eng.gauges()
+        gauges[arm] = g
+        batches = max(res.batches, 1)
+        nq = max(res.queries, 1)
+        if mode == "full":
+            rows_q = g["full_rows_per_forward"] * batches / nq
+            flops_q = g["full_forward_flops"] * batches / nq
+        else:
+            rows_q = g["touched_rows_per_query"]
+            flops_q = g["subgraph_flops_per_query"]
+        out["arms"][arm] = {
+            **res.summary(),
+            "deadline_flushes": eng.batcher.deadline_flushes,
+            "full_flushes": eng.batcher.full_flushes,
+            "compiles": g["compiles"],
+            "rows_per_query": round(float(rows_q), 3),
+            "flops_per_query": round(float(flops_q), 3),
+            "wire_rows_per_query": g["wire_rows_per_query"],
+        }
+    out["arms"]["subgraph"]["touched_rows_per_query"] = \
+        gauges["subgraph"]["touched_rows_per_query"]
+    out["arms"]["subgraph"]["recipe_edges_total"] = \
+        gauges["subgraph"]["recipe_edges_total"]
+    # DETERMINISTIC analytic gauges (the zero-band trend series + the
+    # asserted cut): the measured arms' per-query figures depend on the
+    # open loop's REAL-CLOCK batch composition (deadline flushes vary with
+    # host load), so the acceptance figures are recomputed over a FIXED
+    # chunking of the same query trace — plan/seed-derived only, byte-
+    # reproducible across rounds at equal config
+    out["analytic"] = _subgraph_deterministic_gauges(
+        plan, feats, qids, max_batch, widths,
+        offered_qps=offered_qps, latency_budget_ms=latency_budget_ms)
+    rows_cut = (out["analytic"]["full_rows_per_query"]
+                / max(out["analytic"]["subgraph_rows_per_query"], 1e-9))
+    flops_cut = (out["analytic"]["full_flops_per_query"]
+                 / max(out["analytic"]["subgraph_flops_per_query"], 1e-9))
+    out["rows_per_query_cut"] = round(float(rows_cut), 3)
+    out["flops_per_query_cut"] = round(float(flops_cut), 3)
+    if k > 1 and not (rows_cut >= 10.0 and flops_cut >= 10.0):
+        # the acceptance invariant: sub-graph serving must be
+        # query-proportional enough to cut BOTH analytic per-query bills
+        # >=10x at this query rate
+        raise RuntimeError(
+            f"serve subgraph A/B (hp): per-query cut below 10x "
+            f"(rows {rows_cut:.2f}x, flops {flops_cut:.2f}x)")
+    return out
+
+
+def _subgraph_deterministic_gauges(plan, feats, qids, max_batch: int,
+                                   widths, offered_qps: float = 50.0,
+                                   latency_budget_ms: float = 100.0) -> dict:
+    """Per-query analytic figures of the full-vs-subgraph A/B over a FIXED
+    chunking of ``qids`` — no clock anywhere, so these are zero-band
+    bench-trend counters (``scripts/bench_trend.py``); the measured arms
+    keep their real batch compositions for the honest latency/QPS report.
+
+    The chunk size is the open loop's EXPECTED deadline-flush batch,
+    derived from config alone: ``offered_qps × latency_budget`` queries
+    arrive per budget window (capped at ``max_batch``).  Chunking at
+    ``max_batch`` instead would under-state the full forward's per-query
+    bill — small batches are exactly what makes graph-proportional
+    serving expensive, the regime the ≥10× claim names."""
+    import numpy as np
+
+    from sgcn_tpu.obs.attribution import forward_flops, subgraph_batch_flops
+    from sgcn_tpu.serve import SubgraphIndex, VertexRouter
+    from sgcn_tpu.serve.batcher import pad_pow2
+
+    chunk_size = min(int(max_batch), max(1, int(round(
+        offered_qps * latency_budget_ms / 1e3))))
+    index = SubgraphIndex(plan, "gcn")
+    router = VertexRouter(plan)
+    qids = np.asarray(qids, dtype=np.int64)
+    nq = max(len(qids), 1)
+    nlayers = len(widths)
+    touched = edges = wire = 0
+    nbatches = 0
+    for i in range(0, len(qids), chunk_size):
+        chunk = qids[i: i + chunk_size]
+        by = router.route(chunk)
+        sets = [index.receptive(q, nlayers) for q in by.values()]
+        touched += sum(len(u) for u in sets)
+        edges += sum(index.edges_in(u) for u in sets)
+        wire += pad_pow2(len(chunk), 1)       # the logit psum's padded rows
+        nbatches += 1
+    fin = feats.shape[1]
+    return {
+        "chunking": f"fixed {chunk_size} = min(max_batch, "
+                    "offered_qps x latency_budget)",
+        "full_rows_per_query": round(plan.k * plan.b * nbatches / nq, 3),
+        "full_flops_per_query": round(
+            forward_flops(plan, fin, widths) * nbatches / nq, 3),
+        "subgraph_rows_per_query": round(touched / nq, 3),
+        "subgraph_flops_per_query": round(
+            subgraph_batch_flops(touched, edges, fin, widths) / nq, 3),
+        "wire_rows_per_query": round(wire / nq, 3),
+    }
+
+
 def bench_ab_baseline(args, rev: str) -> dict:
     """Same-session code A/B for the GB-table regime (VERDICT r4 item 9).
 
@@ -1594,6 +1802,12 @@ def main() -> None:
     p.add_argument("--serve-qps-n", type=int, default=20_000,
                    help="graph size for the serve QPS child (forward-only, "
                         "lighter than the training A/Bs)")
+    p.add_argument("--skip-serve-subgraph", action="store_true",
+                   help="skip the full-vs-subgraph serving A/B "
+                        "(serve_subgraph_ab_8dev: shared open-loop traffic, "
+                        ">=10x analytic per-query FLOP/touched-row cut)")
+    p.add_argument("--serve-subgraph-n", type=int, default=20_000,
+                   help="graph size for the serve subgraph A/B child")
     p.add_argument("--skip-ragged-stale-ab", action="store_true",
                    help="skip the three-way composed-mode A/B (a2a+stale "
                         "vs ragged+exact vs ragged+stale) on the virtual "
@@ -1647,6 +1861,8 @@ def main() -> None:
     p.add_argument("--controller-ab-child", action="store_true",
                    help=argparse.SUPPRESS)
     p.add_argument("--serve-qps-child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--serve-subgraph-ab-child", action="store_true",
                    help=argparse.SUPPRESS)
     args = p.parse_args()
 
@@ -1733,6 +1949,15 @@ def main() -> None:
             "value": None,      # the per-transport arm blocks are the payload
             **bench_serve_qps_child(ahat, feats, labels, widths,
                                     graph=args.graph),
+        }))
+        return
+
+    if args.serve_subgraph_ab_child:
+        print(json.dumps({
+            "metric": "serve_subgraph_ab",
+            "value": None,      # the per-mode arm blocks are the payload
+            **bench_serve_subgraph_child(ahat, feats, labels, widths,
+                                         graph=args.graph),
         }))
         return
 
@@ -1876,6 +2101,12 @@ def main() -> None:
             # the serving roofline next to the training one (docs/serving.md)
             vdev_metrics.update(bench_serve_qps(
                 args.serve_qps_n, args.avg_deg, args.f, widths,
+                graph=args.vdev_graph))
+        if (args.model == "gcn" and args.halo_staleness == 0
+                and not args.skip_serve_subgraph):
+            # full-vs-subgraph serving A/B (docs/serving.md phase 2)
+            vdev_metrics.update(bench_serve_subgraph(
+                args.serve_subgraph_n, args.avg_deg, args.f, widths,
                 graph=args.vdev_graph))
     extra = {}
     if not args.vdev_child:

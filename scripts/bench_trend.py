@@ -80,6 +80,18 @@ SERVE_COUNTER_KEYS = ("wire_rows_per_query", "wire_rows_per_exchange")
 # are plan- and depth-derived)
 _SERVE_CFG_KEYS = ("n", "graph", "nnz", "nlayers", "k", "offered_qps",
                    "max_batch")
+# sub-graph serving A/B series (PR-14, the serve_subgraph_ab_8dev block):
+# the block's `analytic` gauges are computed over a FIXED chunking of the
+# seeded query trace (plan-derived, no clock anywhere) — ZERO-band
+# counters scoped on (n, nnz, nlayers, k, schedule, max_batch) per
+# ROADMAP item 3(d).  The ARMS' per-query figures are NOT counters: they
+# ride the open loop's real-clock batch composition (deadline flushes
+# vary with host load), so only latency/QPS report-only series come from
+# the arms (SERVE_REPORT_KEYS, the PR-7 unit rule).
+SUBGRAPH_COUNTER_KEYS = ("full_rows_per_query", "full_flops_per_query",
+                         "subgraph_rows_per_query",
+                         "subgraph_flops_per_query", "wire_rows_per_query")
+_SUBGRAPH_CFG_KEYS = ("n", "nnz", "nlayers", "k", "schedule", "max_batch")
 # hot-halo replication A/B series (PR-10 block, registered PR-12): every
 # one of these is plan-derived and bit-reproducible at fixed config, so
 # they are ZERO-band counters — the measured −11.2% true-rows win is
@@ -226,6 +238,26 @@ def extract_series(history) -> tuple[dict, list]:
                     if _is_num(e.get(ck)):
                         series[("counter", f"serve_{arm}_{ck}")
                                + scfg].append((rnd, float(e[ck])))
+        # sub-graph serving A/B: zero-band DETERMINISTIC analytic counters
+        # from the fixed-chunking block + report-only latency/QPS from the
+        # measured arms (see SUBGRAPH_COUNTER_KEYS)
+        sg = parsed.get("serve_subgraph_ab_8dev")
+        if isinstance(sg, dict):
+            gcfg = tuple(sg.get(k) for k in _SUBGRAPH_CFG_KEYS)
+            for arm, e in (sg.get("arms") or {}).items():
+                if not isinstance(e, dict):
+                    continue
+                for rk in SERVE_REPORT_KEYS:
+                    if _is_num(e.get(rk)):
+                        series[("metric", f"serve_subgraph_{arm}_{rk}",
+                                "serve", rk.rsplit("_", 1)[-1])
+                               + gcfg].append((rnd, float(e[rk])))
+            det = sg.get("analytic")
+            if isinstance(det, dict):
+                for ck in SUBGRAPH_COUNTER_KEYS:
+                    if _is_num(det.get(ck)):
+                        series[("counter", f"serve_subgraph_{ck}")
+                               + gcfg].append((rnd, float(det[ck])))
     return dict(series), gaps
 
 
@@ -268,7 +300,10 @@ def check_series(series: dict, time_band: float = DEFAULT_TIME_BAND) -> list:
 
 def _key_name(key: tuple) -> str:
     if key[0] == "metric" and len(key) > 2 and key[2] == "serve":
-        cfg = [f"{k}={c}" for k, c in zip(_SERVE_CFG_KEYS, key[4:])
+        names = (_SUBGRAPH_CFG_KEYS
+                 if key[1].startswith("serve_subgraph_")
+                 else _SERVE_CFG_KEYS)
+        cfg = [f"{k}={c}" for k, c in zip(names, key[4:])
                if c is not None]
         return f"{key[1]} ({key[3]}" \
                + (", " + ", ".join(cfg) if cfg else "") + ")"
@@ -277,6 +312,10 @@ def _key_name(key: tuple) -> str:
                if c is not None]
         return f"{key[1]} (report-only" \
                + (", " + ", ".join(cfg) if cfg else "") + ")"
+    if key[0] == "counter" and key[1].startswith("serve_subgraph_"):
+        cfg = [f"{k}={c}" for k, c in zip(_SUBGRAPH_CFG_KEYS, key[2:])
+               if c is not None]
+        return f"{key[1]} ({', '.join(cfg)})"
     if key[0] == "counter" and key[1].startswith("serve_"):
         cfg = [f"{k}={c}" for k, c in zip(_SERVE_CFG_KEYS, key[2:])
                if c is not None]

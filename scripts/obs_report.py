@@ -411,6 +411,31 @@ def render(path: str, max_steps: int = 12) -> str:
                     f"    wire ({sv.get('comm_schedule', '?')} schedule): "
                     f"{_fmt(sv['wire_rows_per_query'])} rows/query "
                     "(analytic, plan-derived)")
+            if sv.get("serve_mode") is not None:
+                # v5: engine mode + weight revision + the sub-graph
+                # engine's accumulated per-query analytic gauges
+                extra = ""
+                if sv.get("touched_rows_per_query") is not None:
+                    extra = (f"; {_fmt(sv['touched_rows_per_query'])} "
+                             "touched rows/query, "
+                             f"{_fmt(sv.get('subgraph_flops_per_query'))} "
+                             "FLOPs/query (analytic)")
+                lines.append(
+                    f"    engine: {sv['serve_mode']} mode, weights rev "
+                    f"{int(sv.get('weights_rev', 0))}{extra}")
+
+    swaps = [e for e in log.events if e.get("kind") == "swap"]
+    if swaps:
+        lines.append(f"\nweight hot-swaps: {len(swaps)} (zero-recompile, "
+                     "schema v5)")
+        for sw in swaps:
+            lines.append(
+                f"  rev {int(sw['weights_rev'])} ← "
+                f"{os.path.basename(sw['path'])}"
+                + (f" (ckpt step {int(sw['checkpoint_step'])})"
+                   if sw.get("checkpoint_step") is not None else "")
+                + (f", {_fmt(sw['wall_s'])} s"
+                   if sw.get("wall_s") is not None else ""))
 
     for ev in log.evals():
         lines.append(f"\neval @ step {ev['step']}: loss {_fmt(ev['loss'])}"

@@ -205,6 +205,8 @@ def check_bench_record(rec: dict) -> list[str]:
             errs += check_controller_ab(parsed)
         if "serve_qps_8dev" in parsed:
             errs += check_serve_qps(parsed)
+        if "serve_subgraph_ab_8dev" in parsed:
+            errs += check_serve_subgraph_ab(parsed)
     if isinstance(rec.get("parsed"), dict):
         # flag integrity applies even to failed rounds (cf. `measured`)
         errs += check_resume_provenance(rec["parsed"])
@@ -391,6 +393,88 @@ def check_serve_qps(parsed: dict) -> list[str]:
         errs.append("serve_qps_8dev: missing the honest-measurement note "
                     "naming the wire-row accounting as the asserted figure "
                     "(CPU-mesh latency is not the cross-transport claim)")
+    return errs
+
+
+def check_serve_subgraph_ab(parsed: dict) -> list[str]:
+    """The sub-graph serving A/B contract (PR-14, docs/serving.md phase 2):
+    a ``serve_subgraph_ab_8dev`` block must carry both engine arms (full,
+    subgraph) with positive achieved QPS and ordered positive latency
+    quantiles UNDER ``measured: true`` provenance, positive analytic
+    per-query figures, and the acceptance inequality: the sub-graph arm's
+    analytic rows/query AND FLOPs/query must both sit ≥10× below the full
+    arm's (the ``*_cut`` fields must agree with the arms they summarize —
+    never CPU-mesh latency; the ``note`` must say so).  ``null`` needs a
+    ``serve_subgraph_degraded`` marker."""
+    errs = []
+    block = parsed["serve_subgraph_ab_8dev"]
+    if block is None:
+        if not isinstance(parsed.get("serve_subgraph_degraded"), str):
+            errs.append("serve_subgraph_ab_8dev null without a "
+                        "serve_subgraph_degraded marker "
+                        "(graceful-degradation contract)")
+        return errs
+    if not isinstance(block, dict):
+        return [f"serve_subgraph_ab_8dev is {type(block).__name__}, "
+                "expected dict or null"]
+    if block.get("measured") is not True:
+        errs.append("serve_subgraph_ab_8dev: latency claims without "
+                    "measured:true provenance")
+    arms = block.get("arms")
+    if not isinstance(arms, dict):
+        return errs + ["serve_subgraph_ab_8dev carries no arms dict"]
+    missing = [a for a in ("full", "subgraph")
+               if not isinstance(arms.get(a), dict)]
+    if missing:
+        return errs + [f"serve_subgraph_ab_8dev missing arm(s) {missing}"]
+    for nm in ("full", "subgraph"):
+        e = arms[nm]
+        if not (_is_num(e.get("achieved_qps")) and e["achieved_qps"] > 0):
+            errs.append(f"serve_subgraph_ab_8dev.arms.{nm}.achieved_qps="
+                        f"{e.get('achieved_qps')!r}")
+        p50, p99 = e.get("latency_p50_ms"), e.get("latency_p99_ms")
+        if not (_is_num(p50) and _is_num(p99) and 0 < p50 <= p99):
+            errs.append(f"serve_subgraph_ab_8dev.arms.{nm}: latency "
+                        f"quantiles p50={p50!r} p99={p99!r} "
+                        "(need 0 < p50 <= p99)")
+        for key in ("rows_per_query", "flops_per_query"):
+            if not (_is_num(e.get(key)) and e[key] > 0):
+                errs.append(f"serve_subgraph_ab_8dev.arms.{nm}.{key}="
+                            f"{e.get(key)!r}")
+    det = block.get("analytic")
+    if not isinstance(det, dict):
+        errs.append("serve_subgraph_ab_8dev carries no analytic block — "
+                    "the asserted cuts must come from the DETERMINISTIC "
+                    "fixed-chunking gauges, not the real-clock arms")
+    if errs:
+        return errs
+    for fk, sk, cut_key in (
+            ("full_rows_per_query", "subgraph_rows_per_query",
+             "rows_per_query_cut"),
+            ("full_flops_per_query", "subgraph_flops_per_query",
+             "flops_per_query_cut")):
+        full_v, sub_v = det.get(fk), det.get(sk)
+        if not (_is_num(full_v) and _is_num(sub_v) and full_v > 0
+                and sub_v > 0):
+            errs.append(f"serve_subgraph_ab_8dev.analytic: {fk}={full_v!r} "
+                        f"/ {sk}={sub_v!r}")
+            continue
+        cut = block.get(cut_key)
+        if not (_is_num(cut) and cut >= 10.0):
+            errs.append(f"serve_subgraph_ab_8dev: {cut_key}={cut!r} below "
+                        "the >=10x acceptance cut (the query-proportional "
+                        "claim)")
+        elif abs(cut - full_v / max(sub_v, 1e-9)) > 0.01 * max(cut, 1.0):
+            errs.append(f"serve_subgraph_ab_8dev: {cut_key}={cut!r} "
+                        f"inconsistent with its own analytic block "
+                        f"({full_v}/{sub_v}) — the summary must be "
+                        "derivable from its record")
+    note = block.get("note")
+    if not (isinstance(note, str) and "ANALYTIC" in note):
+        errs.append("serve_subgraph_ab_8dev: missing the honest-"
+                    "measurement note naming the ANALYTIC per-query gauges "
+                    "as the asserted figures (CPU-mesh latency is not the "
+                    "cross-arm claim)")
     return errs
 
 
@@ -601,7 +685,7 @@ def check_replica_ab(parsed: dict) -> list[str]:
 # replica × stale modes of the {a2a,ragged} × {f32,bf16} B>0 staleness-1
 # matrix entry + the banded-fixture composed-ring elision entry; the
 # matrix only grows)
-ANALYSIS_MIN_MODES = 36
+ANALYSIS_MIN_MODES = 39
 
 
 def check_analysis_report(rec: dict) -> list[str]:

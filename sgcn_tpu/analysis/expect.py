@@ -231,6 +231,28 @@ def serve_expectation(engine, mode, bucket: int) -> Expectation:
     return exp
 
 
+def serve_subgraph_expectation(engine, mode, key: tuple) -> Expectation:
+    """Expected contents of one lowered SUB-GRAPH serve program
+    (``ServeEngine.lower_subgraph``) — the tentpole contract: NO exchange
+    collectives at all (every source row is computed locally from
+    host-gathered receptive-set features), no pmax (the GAT stabilizers
+    arrive as an input), no scalar psums (no loss machinery), exactly ONE
+    full-mesh logit-gather psum, and nothing donated (params and batch
+    arrays are reused / engine-owned)."""
+    from ..serve.subgraph import batch_struct
+
+    exp = Expectation()
+    qb = key[1]
+    exp.gather_shapes = [(qb, engine.widths[-1])]
+    groups = [("keep", engine.params),
+              ("keep", np.zeros((engine.nlayers,), np.float32)),  # cgs
+              ("keep", batch_struct(engine.sgindex, key, engine.fin))]
+    exp.args = _classify_args(groups)
+    exp.args += [((qb,), "i32", "keep"),                 # q_owner
+                 ((qb,), "i32", "keep")]                 # q_pos
+    return exp
+
+
 def _classify_args(groups) -> list:
     import jax
 

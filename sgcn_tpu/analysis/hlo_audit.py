@@ -402,6 +402,22 @@ def lower_mode(mode: Mode, plan=None) -> list[tuple]:
             return [(f"bucket{bucket}",
                      eng.lower_bucket(bucket).as_text(),
                      expect.serve_expectation(eng, mode, bucket))]
+    if mode.workload == "serve_subgraph":
+        from ..serve.engine import ServeEngine
+
+        with _gat_form_env(mode.gat_form):
+            eng = ServeEngine(plan, fin=AUDIT_FIN,
+                              widths=list(AUDIT_WIDTHS), model=mode.model,
+                              comm_schedule=mode.schedule,
+                              halo_dtype=mode.halo_dtype,
+                              max_batch=8, buckets=(8,),
+                              precompile=False, mode="subgraph")
+            from ..serve.subgraph import representative_key
+
+            key = representative_key(eng.sgindex)
+            return [("subgraph",
+                     eng.lower_subgraph(key).as_text(),
+                     expect.serve_subgraph_expectation(eng, mode, key))]
     raise ValueError(f"unknown workload {mode.workload!r}")
 
 

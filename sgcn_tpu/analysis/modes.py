@@ -54,7 +54,11 @@ GAT_FORMS = ("fused", "split", "packed")
 class Mode:
     """One point of the supported configuration matrix."""
 
-    workload: str                  # 'train' | 'serve' | 'minibatch'
+    workload: str                  # 'train' | 'serve' | 'serve_subgraph'
+    #                                | 'minibatch'; 'serve_subgraph' is the
+    #                                query-proportional serving program
+    #                                (docs/serving.md phase 2): no
+    #                                per-layer exchange, one logit psum
     model: str                     # 'gcn' | 'gat'
     schedule: str                  # 'a2a' | 'ragged'
     staleness: int = 0             # 0 exact | 1 pipelined
@@ -93,7 +97,7 @@ def is_supported(mode: Mode) -> tuple[bool, str]:
     the constructors raise, so a drift shows up as a wording mismatch in
     review, not a silent matrix hole."""
     m = mode
-    if m.workload not in ("train", "serve", "minibatch"):
+    if m.workload not in ("train", "serve", "serve_subgraph", "minibatch"):
         return False, f"unknown workload {m.workload!r}"
     if m.model not in ("gcn", "gat"):
         return False, f"unknown model {m.model!r}"
@@ -122,13 +126,23 @@ def is_supported(mode: Mode) -> tuple[bool, str]:
         return False, ("replica_budget composed with halo_delta is "
                        "deferred: the delta baseline and the replica "
                        "carry would disagree on what a stale step ships")
-    if m.workload in ("serve", "minibatch") and (m.staleness or m.delta
-                                                 or m.replica):
+    if m.workload in ("serve", "serve_subgraph", "minibatch") and (
+            m.staleness or m.delta or m.replica):
         return False, ("staleness/delta/replication are full-batch "
                        "TRAINING levers; serving always runs the exact "
                        "forward and the mini-batch trainer re-plans per "
                        "batch (replica carries have no stable identity "
                        "across batch plans)")
+    if m.workload == "serve_subgraph" and m.schedule != "a2a":
+        return False, ("the sub-graph serve program ships NO per-layer "
+                       "exchange — its per-row fold is schedule-"
+                       "independent by construction (the hedge family is "
+                       "(dst, round, pos)-sorted), so the matrix audits "
+                       "it once under the a2a-constructed engine")
+    if m.workload == "serve_subgraph" and m.gat_form not in (None, "fused"):
+        return False, ("the sub-graph engine is f32 (no compute_dtype "
+                       "lever) and audits the compact table forms at the "
+                       "plan's natural width — one GAT entry")
     if m.workload == "minibatch" and m.model == "gat":
         # supported by the trainer, but the audit covers the mini-batch
         # envelope once (GCN) — the GAT program is the same per-layer
@@ -165,6 +179,12 @@ def supported_modes() -> list[Mode]:
         modes.append(Mode("serve", "gcn", sched, halo_dtype=hd))
     for sched in ("a2a", "ragged"):
         modes.append(Mode("serve", "gat", sched, gat_form="fused"))
+    # sub-graph serving (docs/serving.md phase 2): the query-proportional
+    # program — no per-layer exchange (schedule-independent fold, audited
+    # once), GCN × wire-cast {f32, bf16} + the GAT compact table form
+    for hd in (None, "bfloat16"):
+        modes.append(Mode("serve_subgraph", "gcn", "a2a", halo_dtype=hd))
+    modes.append(Mode("serve_subgraph", "gat", "a2a", gat_form="fused"))
     # the mini-batch shared-envelope program (one entry: the envelope padding
     # and forced ragged round sizes are what differ from full-batch)
     modes.append(Mode("minibatch", "gcn", "ragged"))

@@ -50,6 +50,8 @@ CONSUMER_TUPLE_SOURCES = {
     "REPLICA_PARTIAL_PLAN_FIELDS":
         "sgcn_tpu.parallel.plan:REPLICA_PARTIAL_PLAN_FIELDS",
     "SERVE_ROUTER_FIELDS": "sgcn_tpu.serve.router:SERVE_ROUTER_FIELDS",
+    "SERVE_SUBGRAPH_FIELDS":
+        "sgcn_tpu.serve.subgraph:SERVE_SUBGRAPH_FIELDS",
 }
 
 # the two CLASSIFICATION tuples (parallel/plan.py) — not consumer tuples

@@ -55,6 +55,25 @@ COMM_A2A = ("a2a",)
 _NEG = -1e30
 
 
+def score_project(z, a2):
+    """Per-row attention-score projection ``z2_i = z_i · a2`` as a ROW-LOCAL
+    multiply-reduce instead of a matvec ``z @ a2``.
+
+    Same math; the form matters for bit-reproducibility: XLA:CPU's gemv
+    kernel makes each output element's accumulation order depend on the
+    ROW's position and the matrix height (measured: permuting rows of a
+    (339, 16) @ (16,) matvec changes bits, and sub-matrices disagree with
+    the full product on scattered rows), while the elementwise-multiply +
+    per-row reduce is position- and height-independent (each row reduces
+    its own K-length chain).  The sub-graph serving path
+    (``serve/subgraph.py``) recomputes boundary rows' scores from COMPACT
+    receptive-set tables and pins f32 bit-identity (``==``) against
+    ``evaluate()`` — only the row-local form can deliver that.  Every
+    consumer (forward, backward remat, the serve stabilizer precompute)
+    rides THIS helper so the projection cannot fork."""
+    return jnp.sum(z * a2, axis=-1)
+
+
 def gat_exchange_lane_widths(widths, compute_dtype: str | None = None):
     """Per-layer wire width of the GAT attention-table exchange, in
     f32-LANE equivalents — THE shared lane model for every byte-accounting
@@ -482,7 +501,7 @@ def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
     b = h.shape[0]
     z = h @ w
     fout = z.shape[-1]
-    z2 = z @ a2
+    z2 = score_project(z, a2)
     # global stabilizer over REAL rows only: pad rows carry z2 = 0, which
     # would floor the max at 0 and turn the underflow guard into an absolute
     # threshold instead of the documented relative-spread limit
@@ -548,7 +567,7 @@ def _gat_layer_sym_bwd(buckets, axis_name, comm, res, gbar):
     b = h.shape[0]
     z = h @ w                                        # remat (see fwd)
     fout = z.shape[-1]
-    u = jnp.exp((z @ a2).astype(jnp.float32) - cg)
+    u = jnp.exp(score_project(z, a2).astype(jnp.float32) - cg)
     # out = N/(D+ε): cotangents of the two aggregations, per dst row
     dng = jnp.maximum(den, 1e-30)                    # same guard as forward
     dn = gbar / dng[:, None]                         # (B, fout)
@@ -698,6 +717,9 @@ def gat_forward_local(
                                     # (ragged; not derivable from rhalo_dst)
     axis_name: str = AXIS,
     halo_carry=None,              # stale-halo carries (trainer contract slot)
+    collect_stabilizers: bool = False,  # static: also return the per-layer
+                                  # softmax stabilizers cg (serving's
+                                  # sub-graph precompute — see below)
 ):
     """Per-chip forward: stacked GAT layers.
 
@@ -758,7 +780,24 @@ def gat_forward_local(
         params = [
             jax.tree.map(lambda x: jax.lax.pcast(x, axis_name, to="varying"),
                          p) for p in params]
+    cgs = []
     for i, p in enumerate(params):
+        if collect_stabilizers:
+            # the layer's own stabilizer, recomputed from the SAME
+            # expressions _gat_factored_fwd_core evaluates (z = h·w,
+            # z2 = score_project, real-row mask, global pmax) — XLA CSEs
+            # the duplicate matmul away, and determinism makes the value
+            # bit-equal to the one the layer uses internally.  Serving's
+            # sub-graph forward (``serve/subgraph.py``) consumes these as
+            # INPUTS: cg is a full-graph max, the one quantity a
+            # receptive-set program cannot derive locally, but it is
+            # constant per (params, features) — precomputed once per
+            # weight swap, it keeps the compact u = exp(z2 − cg) values
+            # bit-identical to the full program's.
+            z2 = score_project(h @ p["w"], p["a2"])
+            z2m = jnp.where(pa["row_valid"] > 0, z2.astype(jnp.float32),
+                            -jnp.inf)
+            cgs.append(jax.lax.pmax(jnp.max(z2m), axis_name))
         h = layer(
             p["w"], p["a1"], p["a2"], h,
             send_idx, halo_src,
@@ -775,4 +814,6 @@ def gat_forward_local(
             # by the sgcn_tpu/analysis wire audit; the byte gauges'
             # gat_exchange_lane_widths always assumed all layers narrow)
             h = h.astype(p["w"].dtype)
+    if collect_stabilizers:
+        return h, jnp.stack(cgs)
     return h

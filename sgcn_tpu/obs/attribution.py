@@ -246,6 +246,42 @@ def step_cost(plan, fin: int, widths, compute_dtype: str | None = None,
     )
 
 
+def forward_flops(plan, fin: int, widths, model: str = "gcn") -> int:
+    """Analytic FLOPs of ONE full partitioned forward over all ``k`` chips
+    (inference: no backward, no optimizer) — the denominator of the
+    sub-graph serving A/B (``docs/serving.md`` phase 2).  Reuses
+    ``step_cost``'s per-chip SpMM/dense models at the padded layout, ×k."""
+    cost = step_cost(plan, fin, widths, model=model)
+    return int(plan.k * (cost.spmm_flops + cost.dense_flops))
+
+
+def subgraph_batch_flops(touched_rows: int, recipe_edges: int, fin: int,
+                         widths, model: str = "gcn") -> int:
+    """Analytic FLOPs of ONE sub-graph serving batch (``serve/subgraph.py``)
+    at its TRUE receptive-set size: per layer, one multiply-add per
+    (recipe edge, lane) at the layer's aggregation width plus the dense
+    projection over the touched rows — the same per-(edge, lane) /
+    per-(row, fin, fout) vocabulary as ``step_cost``, so the A/B ratio
+    against ``forward_flops`` compares like with like.  Deterministic in
+    (graph, queries): a zero-band bench-trend counter."""
+    touched_rows = int(touched_rows)
+    recipe_edges = int(recipe_edges)
+    dims = list(zip([fin] + list(widths)[:-1], widths))
+    total = 0
+    if model == "gat":
+        for fi, fo in dims:
+            # z = h·w, the score projection, and the (fout+1)-lane num/den
+            # gather-macs per combined edge
+            total += 2 * touched_rows * (fi * fo + fo)
+            total += 2 * recipe_edges * (fo + 1)
+    else:
+        from ..models.gcn import exchange_widths
+        for (fi, fo), w in zip(dims, exchange_widths(fin, list(widths))):
+            total += 2 * touched_rows * fi * fo
+            total += 2 * recipe_edges * w
+    return int(total)
+
+
 def add_partial_refresh(cost: StepCostModel, refresh_rows,
                         wire_rows: int, itemsize_fwd: int,
                         itemsize_bwd: int) -> StepCostModel:

@@ -244,6 +244,21 @@ class RunRecorder:
             ev["skipped"] = [str(s) for s in skipped]
         self._emit(ev)
 
+    def record_swap(self, path: str, weights_rev: int,
+                    checkpoint_step: int | None = None,
+                    wall_s: float | None = None) -> None:
+        """One zero-recompile weight hot-swap (schema v5,
+        ``ServeEngine.swap_weights``): emitted after provenance
+        verification and the leaf swap, so every serve event after it
+        describes ``weights_rev``."""
+        ev = {"kind": "swap", "path": str(path),
+              "weights_rev": int(weights_rev)}
+        for k, val in (("checkpoint_step", checkpoint_step),
+                       ("wall_s", wall_s)):
+            if val is not None:
+                ev[k] = val
+        self._emit(ev)
+
     def record_heartbeat(self, event: str, **fields) -> None:
         self._emit({"kind": "heartbeat", "event": str(event),
                     "pid": os.getpid(), **fields})

@@ -45,6 +45,15 @@ Version history:
     ``serve`` events (deadline-shed query count of the window — the
     graceful-degradation counter of the micro-batcher).  Purely additive:
     v1–v3 streams load unchanged and must not carry the v4-only kinds.
+  * **v5** — sub-graph serving + weight hot-swap (``docs/serving.md``
+    phase 2): adds the ``swap`` event kind (one zero-recompile weight
+    hot-swap: checkpoint path, the engine's post-swap ``weights_rev``) and
+    the optional ``serve_mode``/``weights_rev``/``touched_rows_per_query``
+    /``subgraph_flops_per_query`` keys on ``serve`` events — a window
+    spanning a swap is attributable to its weight revisions, and the
+    sub-graph engine's per-query analytic gauges ride the same stream.
+    Purely additive: v1–v4 streams load unchanged and must not carry the
+    v5-only kind.
 """
 
 from __future__ import annotations
@@ -52,8 +61,8 @@ from __future__ import annotations
 import math
 import numbers
 
-SCHEMA_VERSION = 4
-SUPPORTED_VERSIONS = (1, 2, 3, 4)
+SCHEMA_VERSION = 5
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5)
 
 # event stream file names inside a run directory
 MANIFEST_NAME = "manifest.json"
@@ -61,14 +70,16 @@ EVENTS_NAME = "events.jsonl"
 HEARTBEAT_NAME = "heartbeat.jsonl"
 
 EVENT_KINDS = ("step", "eval", "heartbeat", "summary", "span", "serve",
-               "checkpoint", "resume")
-# the span kind is a v2 addition, the serve kind v3, checkpoint/resume v4;
-# a stream claiming an older version must not carry a newer kind
+               "checkpoint", "resume", "swap")
+# the span kind is a v2 addition, the serve kind v3, checkpoint/resume v4,
+# swap v5; a stream claiming an older version must not carry a newer kind
 _KINDS_BY_VERSION = {1: ("step", "eval", "heartbeat", "summary"),
                      2: ("step", "eval", "heartbeat", "summary", "span"),
                      3: ("step", "eval", "heartbeat", "summary", "span",
                          "serve"),
-                     4: EVENT_KINDS}
+                     4: ("step", "eval", "heartbeat", "summary", "span",
+                         "serve", "checkpoint", "resume"),
+                     5: EVENT_KINDS}
 
 _NUM = numbers.Real
 _STR = str
@@ -99,6 +110,10 @@ _REQUIRED = {
     # newest checkpoint was corrupt and an older intact one was used;
     # ``partial_state`` true when a pre-full-state file loaded params-only
     "resume": {"step": _NUM, "path": _STR},
+    # v5: one zero-recompile weight hot-swap (ServeEngine.swap_weights):
+    # emitted AFTER provenance verification and the in-place leaf swap, so
+    # every serve event after it describes the new ``weights_rev``
+    "swap": {"path": _STR, "weights_rev": _NUM},
 }
 
 # kind -> {field: type} (optional, typed when present)
@@ -150,6 +165,14 @@ _OPTIONAL = {
         # were returned as shed markers instead of silently blowing p99
         "shed": _NUM,
         "shed_factor": _NUM,
+        # v5 additive: sub-graph serving + hot-swap attribution
+        # (docs/serving.md phase 2): which engine mode served the window,
+        # under which weight revision, and — sub-graph mode only — the
+        # accumulated per-query receptive-set gauges (analytic, zero-band)
+        "serve_mode": _STR,
+        "weights_rev": _NUM,
+        "touched_rows_per_query": _NUM,
+        "subgraph_flops_per_query": _NUM,
     },
     "checkpoint": {
         "bytes": _NUM,        # committed file size
@@ -159,6 +182,10 @@ _OPTIONAL = {
         "fallback": bool,     # newest checkpoint corrupt, older one used
         "partial_state": bool,  # pre-full-state file: params-only restore
         "skipped": list,      # corrupt checkpoint paths passed over
+    },
+    "swap": {
+        "checkpoint_step": _NUM,  # the swapped checkpoint's training step
+        "wall_s": _NUM,           # load+verify+swap duration (host clock)
     },
 }
 
@@ -333,12 +360,20 @@ def validate_event(ev: dict) -> None:
     if kind == "resume":
         if "step" in ev and isinstance(ev["step"], _NUM) and ev["step"] < 0:
             raise ValueError(f"resume event: negative step={ev['step']}")
+    if kind == "swap":
+        for f in ("weights_rev", "checkpoint_step", "wall_s"):
+            if f in ev and isinstance(ev[f], _NUM) and (
+                    not math.isfinite(ev[f]) or ev[f] < 0):
+                raise ValueError(
+                    f"swap event: non-finite/negative {f}={ev[f]}")
     if kind == "serve":
         for f in ("queries", "achieved_qps", "latency_p50_ms",
                   "latency_p95_ms", "latency_p99_ms", "window_s",
                   "offered_qps", "batches", "mean_batch",
                   "deadline_flushes", "full_flushes", "latency_budget_ms",
-                  "compiles", "wire_rows_per_query", "shed", "shed_factor"):
+                  "compiles", "wire_rows_per_query", "shed", "shed_factor",
+                  "weights_rev", "touched_rows_per_query",
+                  "subgraph_flops_per_query"):
             if f in ev and isinstance(ev[f], _NUM) and (
                     not math.isfinite(ev[f]) or ev[f] < 0):
                 raise ValueError(
@@ -353,6 +388,11 @@ def validate_event(ev: dict) -> None:
         if "mode" in ev and ev["mode"] not in ("open", "closed"):
             raise ValueError(
                 f"serve event: mode={ev['mode']!r} not 'open'/'closed'")
+        if "serve_mode" in ev and ev["serve_mode"] not in ("full",
+                                                          "subgraph"):
+            raise ValueError(
+                f"serve event: serve_mode={ev['serve_mode']!r} not "
+                "'full'/'subgraph'")
     if kind == "step" and isinstance(ev.get("measured_vs_model"), dict):
         _validate_measured_vs_model(ev["measured_vs_model"])
     if kind == "step" and "comm" in ev and ev["comm"] is not None:

@@ -980,6 +980,40 @@ class CommPlan:
         """Stacked per-chip (k, B, f) blocks → global (n, f) row data."""
         return np.asarray(blocks)[self.owner, self.local_idx]
 
+    # ------------------------------------------- receptive-set helpers (serve)
+    def global_row_ids(self) -> np.ndarray:
+        """(k, B) int64: the GLOBAL vertex id living in each (chip, local
+        slot) — the inverse of ``(owner, local_idx)``; −1 on padding slots.
+        The sub-graph serving path (``serve/subgraph.py``) uses this to
+        express each chip's per-row fold recipes in global row space."""
+        out = np.full((self.k, self.b), -1, dtype=np.int64)
+        out[self.owner, self.local_idx] = np.arange(self.n, dtype=np.int64)
+        return out
+
+    def halo_global_rows(self) -> np.ndarray:
+        """(k, R) int64: the GLOBAL vertex id each halo rank holds after one
+        exchange; −1 on padding ranks.  Halo rank ``j`` of chip ``c`` gathers
+        receive-buffer slot ``halo_src[c, j] = q·S + t``, which owner ``q``
+        filled from its local row ``send_idx[q, c, t]`` — so the mapping is
+        derivable from the plan alone, without running an exchange.  Needs
+        the full square plan (a shard-proxy slice has no peers' send
+        lists)."""
+        si = np.asarray(self.send_idx)
+        if si.ndim != 3 or si.shape[0] != si.shape[1]:
+            raise ValueError(
+                f"halo_global_rows needs the full square plan "
+                f"(send_idx {si.shape}); compute it before "
+                "shard_proxy_plan slicing")
+        glob = self.global_row_ids()
+        out = np.full((self.k, self.r), -1, dtype=np.int64)
+        for c in range(self.k):
+            hs = int(self.halo_counts[c])
+            flat = np.asarray(self.halo_src[c, :hs], dtype=np.int64)
+            q = flat // self.s
+            t = flat % self.s
+            out[c, :hs] = glob[q, si[q, c, t]]
+        return out
+
 
 def choose_replica_budget(plan, decision: dict | None = None) -> int:
     """Auto-tune the replica budget B from the plan's λ·degree curve — the

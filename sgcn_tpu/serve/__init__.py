@@ -8,12 +8,15 @@ drives synthetic open/closed-loop traffic.  CLI: ``python -m sgcn_tpu.serve``.
 """
 
 from .batcher import MicroBatcher, default_buckets
-from .engine import SERVE_STAGES, ServeEngine
+from .engine import (SERVE_STAGES, CheckpointWatcher, InFlightBatch,
+                     ServeEngine)
 from .loadgen import ServeResult, run_loadgen, synthetic_query_ids
 from .router import SERVE_ROUTER_FIELDS, VertexRouter
+from .subgraph import SERVE_SUBGRAPH_FIELDS, SubgraphIndex
 
 __all__ = [
-    "MicroBatcher", "SERVE_ROUTER_FIELDS", "SERVE_STAGES", "ServeEngine",
-    "ServeResult", "VertexRouter", "default_buckets", "run_loadgen",
-    "synthetic_query_ids",
+    "CheckpointWatcher", "InFlightBatch", "MicroBatcher",
+    "SERVE_ROUTER_FIELDS", "SERVE_STAGES", "SERVE_SUBGRAPH_FIELDS",
+    "ServeEngine", "ServeResult", "SubgraphIndex", "VertexRouter",
+    "default_buckets", "run_loadgen", "synthetic_query_ids",
 ]

@@ -51,6 +51,21 @@ def default_buckets(max_batch: int) -> tuple[int, ...]:
     return tuple(out)
 
 
+def pad_pow2(x: int, lo: int = 8) -> int:
+    """The SAME doubling rule applied to one dynamic dimension: the
+    smallest power of two ≥ ``max(x, 1)``, floored at ``lo``.  Sub-graph
+    serving (``serve/subgraph.py``) pads every receptive-set dimension
+    (per-degree-class row counts, edge counts, query count) through this,
+    so each compile-key dimension takes at most ``log2`` distinct values
+    and a repeated (or smaller) workload never recompiles — the batcher's
+    bucket contract extended from query counts to receptive-set shapes."""
+    x = max(int(x), 1)
+    out = lo
+    while out < x:
+        out *= 2
+    return out
+
+
 @dataclass
 class Pending:
     """One queued query: global vertex id + the arrival time its latency is

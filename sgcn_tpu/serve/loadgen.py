@@ -106,13 +106,40 @@ class ServeResult:
 
 
 def run_loadgen(engine, qids, offered_qps: float | None = None,
-                clock=time.monotonic, sleep=time.sleep) -> ServeResult:
+                clock=time.monotonic, sleep=time.sleep,
+                concurrent: bool = False) -> ServeResult:
     """Drive ``engine`` (and its batcher) through ``qids``; see module
-    docstring for the open/closed-loop semantics."""
+    docstring for the open/closed-loop semantics.
+
+    ``concurrent=True`` enables DOUBLE-BUFFERED dispatch: batch t+1 is
+    routed/packed/submitted (``engine.submit`` — JAX async dispatch) while
+    batch t's device program is still running, and t's result is consumed
+    only after t+1 is in flight — host batching leaves the critical path.
+    The submit-while-in-flight section is spanned ``serve:overlap`` so the
+    PR-7 trace parser can measure the overlap it names.  At most one batch
+    is in flight behind the current one, results are consumed strictly in
+    submission order, and a query's latency still ends when ITS batch's
+    result is consumed (queue + overlap wait both count — the honest
+    figure)."""
+    import contextlib
+
     qids = np.asarray(qids, dtype=np.int64).reshape(-1)
     batcher = engine.batcher
     res = ServeResult()
     t0 = clock()
+    inflight: list = []                  # [(handle, batch)] — ≤ 1 deep
+
+    def account(batch):
+        done = clock()
+        for p in batch:
+            res.latencies_ms.append((done - p.t_arrival) * 1e3)
+        res.batches += 1
+        res.batch_sizes.append(len(batch))
+
+    def resolve_one():
+        handle, batch = inflight.pop(0)
+        handle.result()
+        account(batch)
 
     def execute(batch):
         if not batch:
@@ -124,12 +151,18 @@ def run_loadgen(engine, qids, offered_qps: float | None = None,
         res.shed += len(shed)
         if not batch:
             return
-        engine.query([p.qid for p in batch])
-        done = clock()
-        for p in batch:
-            res.latencies_ms.append((done - p.t_arrival) * 1e3)
-        res.batches += 1
-        res.batch_sizes.append(len(batch))
+        if not concurrent:
+            engine.query([p.qid for p in batch])
+            account(batch)
+            return
+        spans = getattr(engine, "spans", None)
+        cm = (spans.span("serve:overlap") if spans is not None and inflight
+              else contextlib.nullcontext())
+        with cm:
+            handle = engine.submit([p.qid for p in batch])
+        inflight.append((handle, batch))
+        if len(inflight) > 1:
+            resolve_one()
 
     i = 0
     total = len(qids)
@@ -157,5 +190,7 @@ def run_loadgen(engine, qids, offered_qps: float | None = None,
             execute(batcher.flush())
         else:                            # i == total, nothing pending
             break
+    while inflight:                      # drain the double-buffer tail
+        resolve_one()
     res.window_s = clock() - t0
     return res

@@ -5,10 +5,12 @@ GLOBAL vertex id, but logits live sharded per chip under the plan's vertex
 relabeling (``CommPlan.owner`` / ``CommPlan.local_idx`` — the same arrays
 ``scatter_rows``/``gather_rows`` ride).  The router resolves that mapping on
 the host and validates ids loudly.  ``route`` additionally groups queries by
-owning chip — a diagnostic today (the engine's full-graph forward serves
-every batch through all k chips regardless of ownership) and the grouping
-primitive for the ROADMAP's phase-2 sub-graph forwards, where chip-local
-packing starts to pay.
+owning chip — LOAD-BEARING since sub-graph serving (``serve/subgraph.py``,
+``docs/serving.md`` phase 2): each chip computes only its routed queries'
+L-hop receptive sets, so co-located queries share receptive rows and the
+grouping directly shrinks the per-batch touched-row bill.  (Under the
+full-forward engine it remains a diagnostic: that forward runs on all k
+chips regardless of ownership.)
 
 The gather itself happens IN the compiled forward program (each chip selects
 its own queries and a psum replicates the result — ``engine.py``), so the
@@ -49,8 +51,10 @@ class VertexRouter:
 
     def route(self, qids) -> dict[int, np.ndarray]:
         """Group a batch of query ids by owning partition; chips with no
-        queries are absent.  See the module docstring for where this is
-        (and is not yet) load-bearing."""
+        queries are absent.  The batching primitive of sub-graph serving
+        (see the module docstring): ``build_batch`` computes one receptive
+        set per GROUP, so co-located queries amortize their shared
+        neighborhoods."""
         q = np.asarray(qids, dtype=np.int64).reshape(-1)
         owners, _ = self.lookup(q)
         order = np.argsort(owners, kind="stable")

@@ -114,7 +114,8 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
                           compute_dtype: str | None = None,
                           halo_staleness: int = 0,
                           replica_budget: int | str = 0,
-                          refresh_band: float | None = None
+                          refresh_band: float | None = None,
+                          serve_subgraph: bool = False
                           ) -> ForwardSetup:
     """Resolve (schedule, shipped plan fields, static forward kwargs) for one
     plan — the selection logic that used to live inline in
@@ -211,6 +212,17 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
         # contract are built around the ELL + hedge fold.
         from ..ops.pallas_spmm import PALLAS_PLAN_FIELDS, use_pallas_spmm
         if use_pallas_spmm(plan, fin, widths):
+            if serve_subgraph:
+                # the sub-graph serve engine's compact mirror reproduces
+                # the ELL fold's per-row chains (serve/subgraph.py); the
+                # Pallas tile fold has a different per-row addition
+                # sequence, so bit-parity would silently break — refuse
+                # here, in the ONE selection-rule home, rather than in
+                # the engine
+                raise ValueError(
+                    "sub-graph serving reproduces the ELL fold; this plan "
+                    "resolved to the Pallas VMEM aggregator — serve with "
+                    "mode='full' or set SGCN_PALLAS_SPMM=0")
             plan.ensure_pallas_tiles()
             plan_fields = PALLAS_PLAN_FIELDS
             fwd_static = {

@@ -347,6 +347,80 @@ def test_validator_serve_qps_contract():
     assert any("serve_qps_degraded" in e for e in check_bench_record(rec))
 
 
+def _subgraph_arm(rows, flops, **over):
+    a = {"achieved_qps": 40.0, "latency_p50_ms": 5.0, "latency_p99_ms": 20.0,
+         "queries": 200, "compiles": 6,
+         "rows_per_query": rows, "flops_per_query": flops,
+         "wire_rows_per_query": 1.0}
+    a.update(over)
+    return a
+
+
+def _subgraph_block(**over):
+    b = {"measured": True,
+         "arms": {"full": _subgraph_arm(4000.0, 3.6e6),
+                  "subgraph": _subgraph_arm(100.0, 1.5e5)},
+         "analytic": {"chunking": "fixed max_batch=16",
+                      "full_rows_per_query": 4000.0,
+                      "full_flops_per_query": 3.6e6,
+                      "subgraph_rows_per_query": 100.0,
+                      "subgraph_flops_per_query": 1.5e5,
+                      "wire_rows_per_query": 1.0},
+         "rows_per_query_cut": 40.0,
+         "flops_per_query_cut": 24.0,
+         "note": "the asserted figures are the ANALYTIC per-query gauges; "
+                 "CPU-mesh latency is not the cross-arm claim"}
+    b.update(over)
+    return b
+
+
+def test_validator_serve_subgraph_contract():
+    """The sub-graph serving A/B block (PR-14): null needs a degradation
+    marker; latency claims need measured:true; both analytic per-query
+    cuts must be ≥10× AND derivable from their own arms; the honest note
+    must name the ANALYTIC gauges."""
+    from validate_bench import check_serve_subgraph_ab
+
+    assert any("serve_subgraph_degraded" in e for e in
+               check_serve_subgraph_ab({"serve_subgraph_ab_8dev": None}))
+    assert not check_serve_subgraph_ab(
+        {"serve_subgraph_ab_8dev": None,
+         "serve_subgraph_degraded": "deadline"})
+    assert not check_serve_subgraph_ab(
+        {"serve_subgraph_ab_8dev": _subgraph_block()})
+    errs = check_serve_subgraph_ab(
+        {"serve_subgraph_ab_8dev": _subgraph_block(measured=False)})
+    assert any("measured:true" in e for e in errs)
+    # a cut below the acceptance floor fails
+    weak = _subgraph_block(rows_per_query_cut=4.0)
+    weak["analytic"]["subgraph_rows_per_query"] = 1000.0
+    assert any(">=10x" in e for e in check_serve_subgraph_ab(
+        {"serve_subgraph_ab_8dev": weak}))
+    # a summary cut that disagrees with the deterministic analytic block
+    # is a hand-edit tell
+    lied = _subgraph_block(flops_per_query_cut=50.0)
+    assert any("derivable" in e for e in check_serve_subgraph_ab(
+        {"serve_subgraph_ab_8dev": lied}))
+    # the asserted cuts must come from the DETERMINISTIC block, not the
+    # real-clock arms
+    no_det = _subgraph_block()
+    del no_det["analytic"]
+    assert any("analytic" in e for e in check_serve_subgraph_ab(
+        {"serve_subgraph_ab_8dev": no_det}))
+    assert any("missing arm" in e for e in check_serve_subgraph_ab(
+        {"serve_subgraph_ab_8dev": _subgraph_block(
+            arms={"full": _subgraph_arm(1.0, 1.0)})}))
+    assert any("note" in e for e in check_serve_subgraph_ab(
+        {"serve_subgraph_ab_8dev": _subgraph_block(note="fast")}))
+    # the block rides check_bench_record like the other A/B families
+    rec = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+           "parsed": {"metric": "serve_subgraph_ab", "value": None,
+                      "degraded": "no mesh",
+                      "serve_subgraph_ab_8dev": None}}
+    assert any("serve_subgraph_degraded" in e
+               for e in check_bench_record(rec))
+
+
 def test_validator_rejects_unresolved_comm_schedule():
     rec = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
            "parsed": {"metric": "m", "value": 1.0, "unit": "s",
@@ -382,7 +456,7 @@ def test_validator_cli_exit_codes(tmp_path):
     assert "violation" in r.stdout
 
 
-def _clean_analysis_report(n_modes=36):
+def _clean_analysis_report(n_modes=39):
     modes = {
         f"train/gcn/a2a/s0/m{i}": {
             "ok": True,
