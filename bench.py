@@ -762,6 +762,156 @@ def bench_ragged_ab_child(ahat, feats, labels, widths, epochs: int,
     return out
 
 
+def bench_pallas_ragged_ab(n: int, avg_deg: int, f: int, widths, epochs: int,
+                           graph: str = "ba"):
+    """Three-way A/B of the schedule-agnostic Pallas aggregation
+    (``pallas_ragged_ab_8dev``, ISSUE 15): ELL-ragged vs Pallas-ragged vs
+    Pallas-a2a on the 8-virtual-device CPU mesh over the skewed hp
+    partition.  EMULATE-mode (no TPU here — the kernel's jnp emulation
+    runs, so CPU epoch time is reported honestly and is NEVER the claim);
+    the acceptance figures are the DETERMINISTIC counters: the Pallas
+    ragged arm ships wire rows identical to the ELL ragged arm's, carries
+    ZERO analytic HBM halo-table bytes (the ring receives feed the kernel
+    directly), and trains f32-bit-identically to the Pallas a2a arm.
+    Degrades to a marked partial block on child failure."""
+    block: dict = {"pallas_ragged_ab_8dev": None}
+    try:
+        child = _run_vdev_child(n, avg_deg, f, widths, epochs, graph,
+                                extra_args=("--pallas-ragged-ab-child",))
+        child.pop("metric", None)
+        child.pop("value", None)
+        block["pallas_ragged_ab_8dev"] = child
+        return block
+    except subprocess.TimeoutExpired:
+        print("# pallas ragged A/B run exceeded its deadline",
+              file=sys.stderr)
+        block["pallas_ragged_ab_degraded"] = "deadline"
+        return block
+    except Exception as e:                      # noqa: BLE001 — diagnostic path
+        print(f"# pallas ragged A/B run failed: {e!r}", file=sys.stderr)
+        block["pallas_ragged_ab_degraded"] = repr(e)[:200]
+        return block
+
+
+def bench_pallas_ragged_ab_child(ahat, feats, labels, widths, epochs: int,
+                                 graph: str) -> dict:
+    """One-process kernel × schedule A/B (the ``--pallas-ragged-ab-child``
+    body): arms ``ell_ragged`` / ``pallas_ragged`` / ``pallas_a2a`` over
+    the skewed hp partition, rep-level paired differentials
+    (``paired_differential_multi``).  The VMEM budget is forced generous
+    and ``SGCN_PALLAS_SPMM=1`` pins the selection for the pallas arms —
+    off-TPU the kernel runs in emulate mode, so the epoch times describe
+    THIS host's XLA programs (honest, never the claim); the asserted
+    figures are plan-derived deterministic counters."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from sgcn_tpu.models.gcn import exchange_widths
+    from sgcn_tpu.ops.pallas_spmm import pallas_spmm_fits
+    from sgcn_tpu.parallel import build_comm_plan, make_mesh_1d
+    from sgcn_tpu.parallel.mesh import shard_stacked
+    from sgcn_tpu.partition import partition_hypergraph_colnet
+    from sgcn_tpu.train import FullBatchTrainer, make_train_data
+
+    k = len(jax.devices())
+    n = ahat.shape[0]
+    out: dict = {"n": n, "graph": graph, "k": k,
+                 "timing": "per-step dispatch, one process, rep-level "
+                           "paired differentials; EMULATE-mode kernels "
+                           "(CPU mesh) — epoch speed is reported "
+                           "honestly but is never the claim; the "
+                           "deterministic counters are"}
+    pv, km1 = partition_hypergraph_colnet(ahat, k, seed=0)
+    out["km1"] = int(km1)
+    plan = build_comm_plan(ahat, pv, k)
+    plan.ensure_ragged()
+    os.environ["SGCN_PALLAS_VMEM"] = str(256 * 1024 * 1024)
+    assert pallas_spmm_fits(plan, feats.shape[1], widths,
+                            schedule="ragged")
+    mesh = make_mesh_1d(k)
+    data = make_train_data(plan, feats, labels)
+    data = type(data)(**shard_stacked(mesh, vars(data)))
+    nep = max(6, epochs)
+
+    arms = (("ell_ragged", "0", "ragged"),
+            ("pallas_ragged", "1", "ragged"),
+            ("pallas_a2a", "1", "a2a"))
+    trainers = {}
+
+    def make_trainer(env, schedule):
+        os.environ["SGCN_PALLAS_SPMM"] = env
+        try:
+            return FullBatchTrainer(plan, fin=feats.shape[1],
+                                    widths=widths, mesh=mesh,
+                                    comm_schedule=schedule, seed=2)
+        finally:
+            os.environ.pop("SGCN_PALLAS_SPMM", None)
+
+    def arm(name, env, schedule):
+        tr = make_trainer(env, schedule)
+        assert ("pallas_tb" in tr._fwd_static) == env.startswith("1")
+        trainers[name] = tr
+
+        def make_run(n_ep):
+            def run():
+                loss = None
+                for _ in range(n_ep):
+                    loss = tr.step(data, sync=False)
+                return float(loss)
+            return run
+        return make_run
+
+    from sgcn_tpu.obs.tracing import scoped_span
+    with scoped_span("bench:pallas_ragged_ab:hp", phase="ab_child",
+                     detail=f"n={n} graph={graph}"):
+        times, clean = paired_differential_multi(
+            [arm(*a) for a in arms], nep, what="pallas ragged A/B (hp)")
+
+    # f32 bit-identity between the two pallas arms (same tile fold order
+    # across transports — the tentpole parity contract, asserted on fresh
+    # trainers so the timed state does not leak in)
+    losses = {}
+    for name, env, schedule in arms[1:]:
+        tr = make_trainer(env, schedule)
+        losses[name] = [float(tr.step(data)) for _ in range(3)]
+    if losses["pallas_ragged"] != losses["pallas_a2a"]:
+        raise RuntimeError(
+            f"pallas ragged/a2a losses not bit-identical: {losses}")
+
+    # deterministic counters: identical ragged wire, zero halo-table bytes
+    # in the pallas-ragged arm's analytic roofline
+    wire_rag = plan.wire_rows_per_exchange("ragged")
+    wire_a2a = plan.wire_rows_per_exchange("a2a")
+    fs = exchange_widths(feats.shape[1], list(widths))
+    halo_tab_a2a = 2 * sum(int(plan.r) * int(f_) * 4 for f_ in fs) * k
+    for (name, _env, schedule), t in zip(arms, times):
+        cfg = {
+            "epoch_s": round(t, 6),
+            "measured": True,
+            "wire_rows_per_exchange": (wire_rag if schedule == "ragged"
+                                       else wire_a2a),
+            # per-step bytes of materialized (R, f_ℓ) halo tables across
+            # the mesh (fwd+bwd): the ragged arms fold receives directly
+            # (ELL: redge scatter-add; pallas: in-kernel), only the dense
+            # a2a assembles halo tables
+            "halo_table_bytes_per_step": (0 if schedule == "ragged"
+                                          else halo_tab_a2a),
+        }
+        out[name] = cfg
+    out["clean_reps"] = clean
+    out["true_rows"] = int(plan.predicted_send_volume.sum())
+    if not out["pallas_ragged"]["wire_rows_per_exchange"] == \
+            out["ell_ragged"]["wire_rows_per_exchange"]:
+        raise RuntimeError("pallas ragged arm's wire differs from ELL "
+                           "ragged's — the transport must be untouched")
+    if out["pallas_ragged"]["halo_table_bytes_per_step"] != 0:
+        raise RuntimeError("pallas ragged arm books halo-table bytes")
+    out["pallas_dispatch"] = trainers["pallas_ragged"].comm_decision.get(
+        "pallas_dispatch")
+    return out
+
+
 def bench_ragged_stale_ab(n: int, avg_deg: int, f: int, widths, epochs: int,
                           graph: str = "ba"):
     """Three-way A/B of the COMPOSED mode (``ragged_stale_ab_8dev``):
@@ -1808,6 +1958,13 @@ def main() -> None:
                         ">=10x analytic per-query FLOP/touched-row cut)")
     p.add_argument("--serve-subgraph-n", type=int, default=20_000,
                    help="graph size for the serve subgraph A/B child")
+    p.add_argument("--skip-pallas-ragged-ab", action="store_true",
+                   help="skip the kernel × schedule A/B (ELL-ragged vs "
+                        "Pallas-ragged vs Pallas-a2a, emulate-mode) on "
+                        "the virtual 8-device mesh")
+    p.add_argument("--pallas-ragged-ab-n", type=int, default=15_000,
+                   help="graph size for the pallas ragged A/B child "
+                        "(three arms in one extra CPU-mesh run)")
     p.add_argument("--skip-ragged-stale-ab", action="store_true",
                    help="skip the three-way composed-mode A/B (a2a+stale "
                         "vs ragged+exact vs ragged+stale) on the virtual "
@@ -1855,6 +2012,8 @@ def main() -> None:
     p.add_argument("--gat-ragged-ab-child", action="store_true",
                    help=argparse.SUPPRESS)
     p.add_argument("--ragged-stale-ab-child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--pallas-ragged-ab-child", action="store_true",
                    help=argparse.SUPPRESS)
     p.add_argument("--replica-ab-child", action="store_true",
                    help=argparse.SUPPRESS)
@@ -1913,6 +2072,15 @@ def main() -> None:
             "value": None,      # the per-partition blocks are the payload
             **bench_ragged_ab_child(ahat, feats, labels, widths, args.epochs,
                                     graph=args.graph, model="gat"),
+        }))
+        return
+
+    if args.pallas_ragged_ab_child:
+        print(json.dumps({
+            "metric": "pallas_ragged_ab",
+            "value": None,      # the three-arm block is the payload
+            **bench_pallas_ragged_ab_child(ahat, feats, labels, widths,
+                                           args.epochs, graph=args.graph),
         }))
         return
 
@@ -2080,6 +2248,14 @@ def main() -> None:
             # a2a+stale vs ragged+exact vs ragged+stale
             vdev_metrics.update(bench_ragged_stale_ab(
                 args.ragged_stale_ab_n, args.avg_deg, args.f, widths,
+                max(2, args.epochs // 2), graph=args.vdev_graph))
+        if (args.model == "gcn" and args.halo_staleness == 0
+                and not args.skip_pallas_ragged_ab):
+            # kernel × schedule composition A/B (ISSUE 15): ELL-ragged vs
+            # Pallas-ragged vs Pallas-a2a, emulate-mode deterministic
+            # counters the claim
+            vdev_metrics.update(bench_pallas_ragged_ab(
+                args.pallas_ragged_ab_n, args.avg_deg, args.f, widths,
                 max(2, args.epochs // 2), graph=args.vdev_graph))
         if (args.model == "gcn" and args.halo_staleness == 0
                 and not args.skip_replica_ab):

@@ -113,6 +113,16 @@ _REPLICA_CFG_KEYS = ("n", "graph", "k", "replica_budget", "sync_every")
 # (a retune threshold flip across jax versions must not read as a counter
 # regression) — the per-round winner check lives in validate_bench.
 CONTROLLER_COUNTER_KEYS = ("exposed_wire_rows_per_step",)
+# kernel × schedule A/B series (ISSUE 15, the pallas_ragged_ab_8dev
+# block): per-arm wire rows and analytic halo-table bytes are plan-derived
+# and bit-reproducible at fixed config — ZERO-band counters (the
+# zero-halo-table contract of the pallas ragged arm is literally a zero
+# that may never move); the emulate-mode epoch times stay out entirely
+# (CPU kernel-emulation speed is not a tracked claim, unlike the real
+# trainers' epoch series).
+PALLAS_RAGGED_COUNTER_KEYS = ("wire_rows_per_exchange",
+                              "halo_table_bytes_per_step")
+_PALLAS_RAGGED_CFG_KEYS = ("n", "graph", "k")
 # scalar bench-config fields that scope a wall-clock series: a round run at
 # a different problem size / model / dtype is a DIFFERENT measurement, not
 # a regression (graph already keys separately)
@@ -221,6 +231,19 @@ def extract_series(history) -> tuple[dict, list]:
                             "rows") + ccfg if kind == "metric"
                            else (kind, f"controller_{arm}_{ck}") + ccfg)
                     series[key].append((rnd, float(e[ck])))
+        # kernel × schedule A/B: zero-band plan-derived counters per arm
+        # (see PALLAS_RAGGED_COUNTER_KEYS — the zero-halo-table contract)
+        pb = parsed.get("pallas_ragged_ab_8dev")
+        if isinstance(pb, dict):
+            pcfg = tuple(pb.get(k) for k in _PALLAS_RAGGED_CFG_KEYS)
+            for arm in ("ell_ragged", "pallas_ragged", "pallas_a2a"):
+                e = pb.get(arm)
+                if not isinstance(e, dict):
+                    continue
+                for ck in PALLAS_RAGGED_COUNTER_KEYS:
+                    if _is_num(e.get(ck)):
+                        series[("counter", f"pallas_ragged_{arm}_{ck}")
+                               + pcfg].append((rnd, float(e[ck])))
         # serving-bench series (see SERVE_* docstrings above): per transport
         # arm, report-only latency/QPS + zero-band wire-row counters
         sv = parsed.get("serve_qps_8dev")

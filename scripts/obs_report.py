@@ -108,6 +108,20 @@ def render(path: str, max_steps: int = 12) -> str:
                 f"a2a {cs.get('wire_rows_a2a_replica', '?')}, ragged "
                 f"{cs.get('wire_rows_ragged_replica', '?')} (true "
                 f"{cs.get('true_rows_replica', '?')})")
+        pd = cs.get("pallas_dispatch")
+        if pd:
+            # per-degree-bucket kernel choice of the Pallas family
+            # (ISSUE 15; docs/comm_schedule.md)
+            fams = [(k, pd[k]) for k in ("local", "halo", "combined")
+                    if pd.get(k)]
+            lines.append(
+                f"    pallas dispatch ({pd.get('model')}, tb="
+                f"{pd.get('tb')}, emax cap {pd.get('emax_cap')}): "
+                + "; ".join(
+                    f"{name} [" + " ".join(
+                        f"{c.get('tiles')}x{c.get('emax')}:"
+                        f"{c.get('kernel')}" for c in classes) + "]"
+                    for name, classes in fams))
         ra = cs.get("replica_auto")
         if ra:
             lines.append(

@@ -199,6 +199,8 @@ def check_bench_record(rec: dict) -> list[str]:
             errs += check_ragged_ab(parsed, prefix="gat_ragged_ab")
         if "ragged_stale_ab_8dev" in parsed:
             errs += check_ragged_stale_ab(parsed)
+        if "pallas_ragged_ab_8dev" in parsed:
+            errs += check_pallas_ragged_ab(parsed)
         if "replica_ab_8dev" in parsed:
             errs += check_replica_ab(parsed)
         if "controller_ab_8dev" in parsed:
@@ -601,6 +603,73 @@ def check_ragged_ab(parsed: dict, prefix: str = "ragged_ab") -> list[str]:
     return errs
 
 
+def check_pallas_ragged_ab(parsed: dict) -> list[str]:
+    """The kernel × schedule A/B block contract (ISSUE 15,
+    ``pallas_ragged_ab_8dev``): three arms (``ell_ragged`` /
+    ``pallas_ragged`` / ``pallas_a2a``) with positive MEASURED epoch times
+    (emulate-mode — the honest-measurement note must say CPU epoch speed
+    is never the claim), and the DETERMINISTIC acceptance counters: the
+    pallas ragged arm's wire rows EQUAL the ELL ragged arm's (the kernel
+    must not touch the transport), strictly below the pallas a2a arm's on
+    the skewed hp partition, and ZERO analytic HBM halo-table bytes in
+    both ragged arms while the a2a arm books a positive figure.  ``null``
+    needs a ``pallas_ragged_ab_degraded`` marker."""
+    errs = []
+    block = parsed["pallas_ragged_ab_8dev"]
+    if block is None:
+        if not isinstance(parsed.get("pallas_ragged_ab_degraded"), str):
+            errs.append("pallas_ragged_ab_8dev null without a "
+                        "pallas_ragged_ab_degraded marker "
+                        "(graceful-degradation contract)")
+        return errs
+    if not isinstance(block, dict):
+        return [f"pallas_ragged_ab_8dev is {type(block).__name__}, "
+                "expected dict or null"]
+    note = str(block.get("timing", ""))
+    if "never" not in note or "claim" not in note:
+        errs.append("pallas_ragged_ab_8dev.timing missing the "
+                    "honest-measurement note (CPU epoch speed is never "
+                    "the claim)")
+    arms = ("ell_ragged", "pallas_ragged", "pallas_a2a")
+    for arm in arms:
+        e = block.get(arm)
+        if not isinstance(e, dict):
+            errs.append(f"pallas_ragged_ab_8dev.{arm} missing")
+            continue
+        if not (_is_num(e.get("epoch_s")) and e["epoch_s"] > 0):
+            errs.append(f"pallas_ragged_ab_8dev.{arm}.epoch_s="
+                        f"{e.get('epoch_s')!r}")
+        if e.get("measured") is not True:
+            errs.append(f"pallas_ragged_ab_8dev.{arm}: epoch_s claim "
+                        "without measured: true provenance")
+    if all(isinstance(block.get(a), dict) for a in arms):
+        wr = block["pallas_ragged"].get("wire_rows_per_exchange")
+        we = block["ell_ragged"].get("wire_rows_per_exchange")
+        wa = block["pallas_a2a"].get("wire_rows_per_exchange")
+        if not (_is_num(wr) and _is_num(we) and wr == we):
+            errs.append(f"pallas_ragged_ab_8dev: pallas ragged wire "
+                        f"{wr!r} != ELL ragged wire {we!r} — the kernel "
+                        "must not touch the transport")
+        if not (_is_num(wr) and _is_num(wa) and wr < wa):
+            errs.append(f"pallas_ragged_ab_8dev: pallas ragged wire "
+                        f"{wr!r} not STRICTLY below the a2a pad {wa!r} "
+                        "on the skewed partition")
+        for arm in ("ell_ragged", "pallas_ragged"):
+            hb = block[arm].get("halo_table_bytes_per_step")
+            if hb != 0:
+                errs.append(f"pallas_ragged_ab_8dev.{arm}: "
+                            f"halo_table_bytes_per_step={hb!r} — the "
+                            "ragged arms must book ZERO HBM halo-table "
+                            "bytes (in-kernel fold)")
+        ha = block["pallas_a2a"].get("halo_table_bytes_per_step")
+        if not (_is_num(ha) and ha > 0):
+            errs.append(f"pallas_ragged_ab_8dev.pallas_a2a: "
+                        f"halo_table_bytes_per_step={ha!r} (the dense "
+                        "exchange assembles halo tables — a zero here "
+                        "means the analytic model broke)")
+    return errs
+
+
 def check_replica_ab(parsed: dict) -> list[str]:
     """The hot-halo-replication A/B block contract (PR-10,
     docs/replication.md): a ``replica_ab_8dev`` block must carry B > 0,
@@ -681,11 +750,11 @@ def check_replica_ab(parsed: dict) -> list[str]:
 
 
 # the supported-matrix floor a committed analysis report may not shrink
-# below (36 mode entries at PR-12 HEAD: PR-10's 31 + the four composed
-# replica × stale modes of the {a2a,ragged} × {f32,bf16} B>0 staleness-1
-# matrix entry + the banded-fixture composed-ring elision entry; the
+# below (48 mode entries at PR-15 HEAD: PR-14's 39 + the eight Pallas
+# kernel-family modes — {a2a,ragged} × (GCN × {f32,bf16 wire} ∪ GAT ×
+# {fused,split}) — + the banded-fixture ragged-pallas elision entry; the
 # matrix only grows)
-ANALYSIS_MIN_MODES = 39
+ANALYSIS_MIN_MODES = 48
 
 
 def check_analysis_report(rec: dict) -> list[str]:

@@ -63,6 +63,15 @@ class Expectation:
     # argument classification for the donation check, in flatten order:
     # list of (shape, dtype, klass) with klass in {'donate', 'keep'}
     args: list = field(default_factory=list)
+    # result SHAPES no scatter op in the program may produce — the
+    # halo-materialization rule of the ragged-Pallas modes: assembling the
+    # (R, f_ℓ) halo table before the kernel (instead of feeding the ring's
+    # receive concat to the VMEM tile accumulator directly) betrays itself
+    # as a scatter with exactly that signature.  Shapes that collide with
+    # the program's LEGITIMATE scatters (the emulate-mode segment-sums'
+    # per-class (T_c·tb, f) blocks, the (B, f) folds) are dropped at
+    # expectation-build time, never silently matched.
+    forbidden_scatters: list = field(default_factory=list)
 
 
 def _gcn_layer_plan(fin: int, widths) -> tuple[list, list]:
@@ -107,6 +116,36 @@ def _wire_dtypes_gcn(mode, fresh: bool) -> tuple[str, str]:
         # full f32 row (both ends reset exactly — docs/stale_halo.md)
         return ("f32" if fresh else "bf16"), base
     return base, base
+
+
+def pallas_ragged_forbidden_scatters(trainer, mode) -> list:
+    """The ragged-Pallas halo-materialization rule's forbidden scatter
+    result shapes: ``(R, f_ℓ)`` at every lane width the mode's exchanges
+    ship (GCN: ``exchange_widths``; GAT: the fused ``fout+1`` and split
+    ``fout`` table heights).  Shapes colliding with the program's
+    legitimate scatter outputs — the per-class ``(T_c·tb, f)`` blocks of
+    the emulate-mode segment-sums and the ``(B, f)`` folds — are dropped
+    (a collision would turn the lint vacuous OR false-positive; dropping
+    is the conservative side and the audit fixture does not collide)."""
+    if not getattr(mode, "pallas", False) or mode.schedule != "ragged":
+        return []
+    plan = trainer.plan
+    legit = {int(plan.b)}
+    for cls, tb in ((plan.pallas_lclasses, plan.pallas_tb),
+                    (plan.pallas_hclasses, plan.pallas_tb),
+                    (plan.pallas_cclasses, plan.pallas_ctb)):
+        if cls and tb:
+            legit |= {int(t) * int(tb) for t, _e in cls}
+    if int(plan.r) in legit:
+        return []
+    if mode.model == "gcn":
+        fs, _ = _gcn_layer_plan(trainer.fin, trainer.widths)
+        lanes = set(int(f) for f in fs)
+    else:
+        lanes = set()
+        for fout in trainer.widths:
+            lanes |= {int(fout), int(fout) + 1}
+    return [(int(plan.r), lane) for lane in sorted(lanes)]
 
 
 def train_expectation(trainer, mode, fresh: bool = False) -> Expectation:
@@ -173,6 +212,7 @@ def train_expectation(trainer, mode, fresh: bool = False) -> Expectation:
     exp.grad_shapes = [tuple(np.shape(x))
                        for x in jax.tree.leaves(trainer.params)]
     exp.scalar_psums = XENT_SCALAR_PSUMS
+    exp.forbidden_scatters = pallas_ragged_forbidden_scatters(trainer, mode)
 
     # argument classification (donation): the jit args in flatten order
     groups = [("donate", trainer.params), ("donate", trainer.opt_state)]

@@ -82,6 +82,23 @@ class HloOp:
         return self.operand_types[0] if self.operand_types else ((), "?")
 
 
+def _scan_op_signature(lines, i: int, max_span: int):
+    """Scan from an op head at line ``i`` to the ``: (…) -> …`` type
+    signature that closes it (ops with regions — ``all_reduce``,
+    ``scatter`` — span many lines; region-body ops print bare
+    ``: tensor<…>`` forms that never match the parenthesized signature).
+    THE one extraction shared by every op inventory here; returns
+    ``(sig_match_or_None, joined_text)``."""
+    joined = []
+    sig = None
+    for j in range(i, min(i + max_span, len(lines))):
+        joined.append(lines[j])
+        sig = _TYPE_SIG_RE.search(lines[j])
+        if sig:
+            break
+    return sig, "\n".join(joined)
+
+
 def collective_ops(text: str, max_span: int = 400) -> list[HloOp]:
     """Inventory every collective / host-transfer StableHLO op in a lowered
     module.  Ops with regions (``all_reduce``) span lines; the op's operand
@@ -95,14 +112,8 @@ def collective_ops(text: str, max_span: int = 400) -> list[HloOp]:
         if not m:
             continue
         kind = m.group(1)
-        joined = []
-        sig = None
-        for j in range(i, min(i + max_span, len(lines))):
-            joined.append(lines[j])
-            sig = _TYPE_SIG_RE.search(lines[j])
-            if sig:
-                break
-        op = HloOp(kind=kind, line=i, text="\n".join(joined))
+        sig, joined = _scan_op_signature(lines, i, max_span)
+        op = HloOp(kind=kind, line=i, text=joined)
         if sig:
             op.operand_types = [parse_tensor_type(t)
                                 for t in _TENSOR_RE.findall(sig.group(1))]
@@ -118,6 +129,29 @@ def collective_ops(text: str, max_span: int = 400) -> list[HloOp]:
 def custom_call_targets(text: str) -> list[str]:
     """Every ``stablehlo.custom_call @Target`` in the module, in order."""
     return _CUSTOM_TARGET_RE.findall(text)
+
+
+_SCATTER_HEAD_RE = re.compile(r'"?stablehlo\.scatter"?\b')
+
+
+def scatter_result_types(text: str, max_span: int = 400) -> list[tuple]:
+    """Result ``(shape, dtype)`` of every ``stablehlo.scatter`` op in the
+    module — the halo-materialization rule of the ragged-Pallas audit
+    (``expect.Expectation.forbidden_scatters``): a program that assembles
+    the ``(R, f)`` halo table before the kernel betrays itself as a
+    scatter with exactly that result signature.  Scatter ops carry an
+    update-computation region, so extraction rides the shared
+    ``_scan_op_signature`` scan ``collective_ops`` uses."""
+    lines = text.splitlines()
+    out: list[tuple] = []
+    for i, ln in enumerate(lines):
+        if not _SCATTER_HEAD_RE.search(ln):
+            continue
+        sig, _joined = _scan_op_signature(lines, i, max_span)
+        if sig:
+            out += [parse_tensor_type(t)
+                    for t in _TENSOR_RE.findall(sig.group(2))]
+    return out
 
 
 def host_callback_targets(text: str) -> list[str]:

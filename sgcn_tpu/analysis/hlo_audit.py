@@ -26,8 +26,10 @@ module text against the plan-derived :class:`~.expect.Expectation`:
     plan arrays and batch data do NOT; serve programs donate NOTHING.
 
 A violation names its rule (``collective-census`` / ``wire-dtype`` /
-``wire-shape`` / ``host-callback`` / ``donation``) so the tier-1 mutation
-checks (``tests/test_analysis.py``) can prove each rule class fails on a
+``wire-shape`` / ``host-callback`` / ``donation`` /
+``halo-materialization`` — the ragged-Pallas modes' "no HBM halo table"
+contract) so the tier-1 mutation checks (``tests/test_analysis.py``,
+``tests/test_pallas_ragged.py``) can prove each rule class fails on a
 seeded violation.
 """
 
@@ -95,6 +97,24 @@ def audit_plan(kind: str = "er"):
         raise ValueError(f"unknown audit fixture {kind!r}")
     plan = build_comm_plan(ahat, pv, AUDIT_K)
     return plan
+
+
+@contextlib.contextmanager
+def _pallas_env(on: bool):
+    """Pin the kernel-family selection for the duration of a trace:
+    ``use_pallas_spmm`` reads ``$SGCN_PALLAS_SPMM`` at call time, and the
+    audit must be deterministic BOTH ways — a pallas mode forces the
+    kernel on, every other mode forces it off (an ambient =1 in the
+    operator's shell must not flip the non-pallas census)."""
+    old = os.environ.get("SGCN_PALLAS_SPMM")
+    os.environ["SGCN_PALLAS_SPMM"] = "1" if on else "0"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("SGCN_PALLAS_SPMM", None)
+        else:
+            os.environ["SGCN_PALLAS_SPMM"] = old
 
 
 @contextlib.contextmanager
@@ -245,6 +265,24 @@ def check_program(text: str, exp: "expect.Expectation", k: int) -> tuple:
             "programs ship halos by all_to_all/ppermute and reduce by "
             "psum only"))
 
+    # ---- halo materialization (ragged-Pallas modes): the ring's receive
+    # buffers must feed the kernel directly — a scatter producing the
+    # (R, f_ℓ) halo-table signature means the program assembled the HBM
+    # halo table first (expect.pallas_ragged_forbidden_scatters; shapes
+    # colliding with legitimate scatters were dropped at build time)
+    if exp.forbidden_scatters:
+        from .hlo import scatter_result_types
+
+        seen = {tuple(s) for s, _d in scatter_result_types(text)}
+        hits = [s for s in exp.forbidden_scatters if tuple(s) in seen]
+        if hits:
+            violations.append(_viol(
+                "halo-materialization",
+                f"scatter(s) with halo-table result shape(s) {hits} — "
+                "the ragged-Pallas program must fold ring receives "
+                "inside the VMEM tile accumulator, never assemble the "
+                "(R, f) halo table in HBM"))
+
     # ---- host transfers / callbacks
     transfers = [op.kind for op in ops if op.kind in HOST_TRANSFER_KINDS]
     if transfers:
@@ -349,10 +387,21 @@ def lower_mode(mode: Mode, plan=None) -> list[tuple]:
                       else 0)
         else:
             kw.update(compute_dtype=mode.compute_dtype)
-        with _gat_form_env(mode.gat_form):
+        with _gat_form_env(mode.gat_form), \
+                _pallas_env(getattr(mode, "pallas", False)):
             tr = FullBatchTrainer(plan, fin=AUDIT_FIN,
                                   widths=list(AUDIT_WIDTHS),
                                   model=mode.model, **kw)
+            # the audit must never silently check the WRONG aggregator:
+            # a pallas mode that fell back to the slot-pass path would
+            # share its census and pass vacuously
+            if getattr(mode, "pallas", False) != \
+                    ("pallas_tb" in tr._fwd_static):
+                raise RuntimeError(
+                    f"mode {mode.mode_id}: Pallas selection "
+                    f"{'did not fire' if mode.pallas else 'fired'} "
+                    "(fwd_static keys "
+                    f"{sorted(tr._fwd_static)})")
             if mode.staleness:
                 return [
                     ("stale", tr.lower_step(kind="stale").as_text(),
@@ -381,18 +430,19 @@ def lower_mode(mode: Mode, plan=None) -> list[tuple]:
                 "the minibatch audit entry builds its own per-batch plans "
                 "from the ER fixture graph; a custom plan would be "
                 "silently ignored here — extend lower_mode instead")
-        mb = MiniBatchTrainer(
-            _audit_ahat(), np.asarray(audit_plan().owner), AUDIT_K,
-            fin=AUDIT_FIN, widths=list(AUDIT_WIDTHS),
-            batch_size=AUDIT_N // 2, nbatches=2,
-            comm_schedule=mode.schedule)
-        return [("envelope-step", mb.lower_step().as_text(),
-                 expect.train_expectation(mb.inner, mode))]
+        with _pallas_env(False):
+            mb = MiniBatchTrainer(
+                _audit_ahat(), np.asarray(audit_plan().owner), AUDIT_K,
+                fin=AUDIT_FIN, widths=list(AUDIT_WIDTHS),
+                batch_size=AUDIT_N // 2, nbatches=2,
+                comm_schedule=mode.schedule)
+            return [("envelope-step", mb.lower_step().as_text(),
+                     expect.train_expectation(mb.inner, mode))]
     if mode.workload == "serve":
         from ..serve.engine import ServeEngine
 
         bucket = 8
-        with _gat_form_env(mode.gat_form):
+        with _gat_form_env(mode.gat_form), _pallas_env(False):
             eng = ServeEngine(plan, fin=AUDIT_FIN,
                               widths=list(AUDIT_WIDTHS), model=mode.model,
                               comm_schedule=mode.schedule,
@@ -405,7 +455,7 @@ def lower_mode(mode: Mode, plan=None) -> list[tuple]:
     if mode.workload == "serve_subgraph":
         from ..serve.engine import ServeEngine
 
-        with _gat_form_env(mode.gat_form):
+        with _gat_form_env(mode.gat_form), _pallas_env(False):
             eng = ServeEngine(plan, fin=AUDIT_FIN,
                               widths=list(AUDIT_WIDTHS), model=mode.model,
                               comm_schedule=mode.schedule,
@@ -469,7 +519,12 @@ def run_audit(modes=None, fast: bool = False) -> dict:
                      # the composed replica × stale ring: the SHRUNKEN
                      # nrep ring's empty rounds must elide too
                      Mode("train", "gcn", "ragged", staleness=1,
-                          replica=True)):
+                          replica=True),
+                     # the ragged-Pallas ring rides the same elision rule
+                     # (pallas_ring_concat skips S_d = 0 rounds at trace
+                     # time) — and the halo-materialization rule must
+                     # hold on a partially-live ring too
+                     Mode("train", "gcn", "ragged", pallas=True)):
             entry = audit_mode(mode, plan=banded)
             out["modes"][mode.mode_id + "@banded"] = entry
             out["ok"] = out["ok"] and entry["ok"]

@@ -68,6 +68,12 @@ class Mode:
     replica: bool = False          # hot-halo replication, B > 0 (GCN only;
     #                                the axis is binary — the audit runs at
     #                                a fixed small B, hlo_audit.AUDIT_REPLICA_B)
+    pallas: bool = False           # VMEM Pallas aggregator (exact mode,
+    #                                both models × both schedules — the
+    #                                env-selected kernel family,
+    #                                ops/pallas_spmm.py::use_pallas_spmm;
+    #                                the audit pins SGCN_PALLAS_SPMM per
+    #                                mode)
 
     @property
     def mode_id(self) -> str:
@@ -81,6 +87,8 @@ class Mode:
                 parts.append("delta")
             if self.replica:
                 parts.append("rep")
+        if self.pallas:
+            parts.append("pallas")
         return "/".join(parts)
 
     @property
@@ -151,6 +159,25 @@ def is_supported(mode: Mode) -> tuple[bool, str]:
     if m.workload == "serve" and m.gat_form == "packed":
         return False, ("the serve engine has no compute_dtype lever — the "
                        "packed form is a training-side wire shape")
+    if m.pallas:
+        if m.workload != "train":
+            return False, ("the Pallas kernel family is audited on the "
+                           "train step programs; serving rides the "
+                           "identical resolve_forward_setup branch (and "
+                           "the sub-graph engine refuses it outright — "
+                           "its compact mirror reproduces the ELL fold), "
+                           "while the mini-batch envelope passes "
+                           "allow_pallas=False (one compiled step, many "
+                           "per-batch plans — no shared tile layout)")
+        if m.staleness or m.delta or m.replica:
+            return False, ("the stale/replica carry contracts are built "
+                           "around the ELL + hedge fold; the Pallas "
+                           "aggregator is an exact-mode lever")
+        if m.gat_form == "packed":
+            return False, ("the packed bf16 table bit-pairs lanes into "
+                           "f32 words the kernel's f32 accumulate cannot "
+                           "consume without an in-kernel unpack — "
+                           "deferred (use_pallas_spmm gates it)")
     return True, "supported"
 
 
@@ -170,9 +197,18 @@ def supported_modes() -> list[Mode]:
             (False, True)):
         modes.append(Mode("train", "gcn", sched, stale, hd, delta,
                           replica=rep))
-    # train / GAT: schedule × table form
-    for sched, form in itertools.product(("a2a", "ragged"), GAT_FORMS):
-        modes.append(Mode("train", "gat", sched, gat_form=form))
+    # train / GCN / Pallas: schedule × halo-dtype at exact mode — the
+    # schedule-agnostic VMEM kernel family (pspmm_pallas_sym/_ragged)
+    for sched, hd in itertools.product(("a2a", "ragged"),
+                                       (None, "bfloat16")):
+        modes.append(Mode("train", "gcn", sched, halo_dtype=hd,
+                          pallas=True))
+    # train / GAT: schedule × table form (× the Pallas slot pass for the
+    # f32 fused/split forms — is_supported filters packed+pallas)
+    for sched, form, pal in itertools.product(("a2a", "ragged"), GAT_FORMS,
+                                              (False, True)):
+        modes.append(Mode("train", "gat", sched, gat_form=form,
+                          pallas=pal))
     # serve: model × schedule (× halo-dtype for GCN, × form for GAT)
     for sched, hd in itertools.product(("a2a", "ragged"),
                                        (None, "bfloat16")):

@@ -31,6 +31,12 @@ import importlib
 # imports at module load)
 CONSUMER_TUPLE_SOURCES = {
     "PALLAS_PLAN_FIELDS": "sgcn_tpu.ops.pallas_spmm:PALLAS_PLAN_FIELDS",
+    "PALLAS_PLAN_FIELDS_RAGGED":
+        "sgcn_tpu.ops.pallas_spmm:PALLAS_PLAN_FIELDS_RAGGED",
+    "GAT_PLAN_FIELDS_PALLAS":
+        "sgcn_tpu.models.gat:GAT_PLAN_FIELDS_PALLAS",
+    "GAT_PLAN_FIELDS_PALLAS_RAGGED":
+        "sgcn_tpu.models.gat:GAT_PLAN_FIELDS_PALLAS_RAGGED",
     "GAT_PLAN_FIELDS": "sgcn_tpu.models.gat:GAT_PLAN_FIELDS",
     "GAT_PLAN_FIELDS_RAGGED":
         "sgcn_tpu.models.gat:GAT_PLAN_FIELDS_RAGGED",

@@ -46,6 +46,16 @@ GAT_PLAN_FIELDS = ("send_idx", "halo_src", "cell_idx", "cell_w",
 # (the (fout+1)-lane attention table) differs.
 GAT_PLAN_FIELDS_RAGGED = ("rsend_idx", "rhalo_dst", "cell_idx", "cell_w",
                           "ctail_dst", "ctail_src", "ctail_w", "row_valid")
+# Under the Pallas VMEM aggregator (``use_pallas_spmm`` fires for GAT too)
+# the bucketed slot passes swap for mask-weighted runs of the dst-tile
+# kernel over the COMBINED-edge tile classes
+# (``CommPlan.ensure_pallas_cell_tiles``); the ragged flavor reads the
+# ring's receive concat directly (``ptile_crsrc`` ring-re-based sources —
+# no halo table, so ``rhalo_dst`` is NOT shipped).
+GAT_PLAN_FIELDS_PALLAS = ("send_idx", "halo_src", "ptile_csrc", "ptile_cld",
+                          "ptile_cw", "row_valid")
+GAT_PLAN_FIELDS_PALLAS_RAGGED = ("rsend_idx", "ptile_crsrc", "ptile_cld",
+                                 "ptile_cw", "row_valid")
 
 # static comm spec threaded through the layer stack: ('a2a',) selects the
 # dense all_to_all, ('ragged', rr_sizes, r) the per-round ppermute ring —
@@ -472,6 +482,61 @@ def _packed_aggregate(rows16, scalar, fout, send_idx, halo_src, cell_idx,
                       slot_bytes=lambda nb: nb * (half + 1 + fout) * 4)
 
 
+def _is_pallas_comm(comm) -> bool:
+    return comm[0] in ("a2a+pallas", "ragged+pallas")
+
+
+def _gat_pallas_aggregate(p, s, fout, form, send_idx, halo_src,
+                          csrc, cw, cld, axis_name, comm):
+    """The GAT attention slot pass on the VMEM kernel: masked Σ of the
+    ``[p ‖ s]`` table over combined-edge tile classes.  The WIRE is
+    form-for-form the slot-pass path's (``gat_table_form`` — the audit's
+    census does not change): ``fused`` ships one ``(·, fout+1)`` table and
+    runs ONE kernel pass whose trailing lane is the scalar sum; ``split``
+    ships the feature table and the scalar separately (two dense
+    dispatches / one two-lane ring) and runs two kernel passes.  The
+    ragged flavor feeds the ring's round-major receive concat to the
+    kernel directly (``pallas_ring_concat`` — no halo-table scatter), with
+    tile sources ring-re-based at plan time, so its bits equal the a2a
+    flavor's (same tile fold order).  Returns ``(N (b, fout), D (b,))``.
+    """
+    from ..ops.pallas_spmm import gat_pallas_pass, pallas_ring_concat
+
+    tbp, cclasses, pemu = comm[-1]
+    b = p.shape[0]
+    ragged = comm[0] == "ragged+pallas"
+    if form == "fused":
+        table = jnp.concatenate([p, s[:, None]], axis=-1)
+        halo = (pallas_ring_concat(table, send_idx, comm[1], axis_name)
+                if ragged
+                else halo_exchange(table, send_idx, halo_src, axis_name))
+        full = jnp.concatenate([table, halo], axis=0)
+        out = gat_pallas_pass(csrc, cld, cw, full.astype(jnp.float32),
+                              cclasses, tbp, pemu, axis_name, b)
+        return out[:, :fout], out[:, fout]
+    if form != "split":
+        raise ValueError(
+            f"the Pallas slot pass takes the fused/split table forms, not "
+            f"{form!r} (use_pallas_spmm gates the packed bf16 form out)")
+    if ragged:
+        # one two-lane ring per exchange, exactly _exchange_rows_scalar's
+        # ragged wire; the concat exists only at round size
+        pair = jnp.concatenate([p, s[:, None]], axis=-1)
+        ring = pallas_ring_concat(pair, send_idx, comm[1], axis_name)
+        full_p = jnp.concatenate([p, ring[:, :fout]], axis=0)
+        full_u = jnp.concatenate([s, ring[:, fout]])
+    else:
+        # the dense split wire has ONE home — the slot-pass path's helper
+        full_p, full_u = _exchange_rows_scalar(p, s, send_idx, halo_src,
+                                               axis_name)
+    num = gat_pallas_pass(csrc, cld, cw, full_p.astype(jnp.float32),
+                          cclasses, tbp, pemu, axis_name, b)
+    den = gat_pallas_pass(csrc, cld, cw,
+                          full_u[:, None].astype(jnp.float32),
+                          cclasses, tbp, pemu, axis_name, b)[:, 0]
+    return num, den
+
+
 def _use_packed(dtype, fout: int) -> bool:
     return dtype == jnp.bfloat16 and fout % 2 == 0
 
@@ -512,7 +577,15 @@ def _gat_factored_fwd_core(w, a2, h, send_idx, halo_src, cell_idx, cell_w,
     cg = jax.lax.pmax(jax.lax.stop_gradient(jnp.max(z2m)), axis_name)
     u = jnp.exp(z2.astype(jnp.float32) - cg)         # (B,) in (0, 1]
     form = gat_table_form(fout, z.dtype)
-    if form == "packed":
+    if _is_pallas_comm(comm):
+        # VMEM-kernel slot pass: under the Pallas comm spec the cell_idx/
+        # cell_w/ctail_dst slots carry the combined TILE arrays
+        # (ptile_c[r]src / ptile_cw / ptile_cld — see gat_forward_local)
+        p = u.astype(z.dtype)[:, None] * z
+        num, den = _gat_pallas_aggregate(
+            p, u.astype(z.dtype), fout, form, send_idx, halo_src,
+            cell_idx, cell_w, ctail_dst, axis_name, comm)
+    elif form == "packed":
         # bf16 compute: ONE gather per edge carries [u·z ‖ u] bit-packed
         p16 = u.astype(jnp.bfloat16)[:, None] * z
         num, den = _packed_aggregate(
@@ -577,7 +650,13 @@ def _gat_layer_sym_bwd(buckets, axis_name, comm, res, gbar):
     # backward's [ḡ/D ‖ −(ḡ·out)/D] table rides the SAME transport (comm)
     # as the forward's, so the ragged ring carries both directions
     form = gat_table_form(fout, z.dtype)
-    if form == "packed":
+    if _is_pallas_comm(comm):
+        # backward table rides the SAME transport and kernel as the
+        # forward's (symmetric pattern: transpose = the same passes)
+        dp, du_agg = _gat_pallas_aggregate(
+            dn, dd, fout, form, send_idx, halo_src,
+            cell_idx, cell_w, ctail_dst, axis_name, comm)
+    elif form == "packed":
         dp, du_agg = _packed_aggregate(
             dn.astype(jnp.bfloat16), dd, fout, send_idx, halo_src,
             cell_idx, cell_w, ctail_dst, ctail_src, ctail_w, buckets, b,
@@ -715,6 +794,11 @@ def gat_forward_local(
     rr_sizes: tuple | None = None,  # static plan.rr_sizes (ragged)
     halo_r: int | None = None,      # static plan.r — halo table height
                                     # (ragged; not derivable from rhalo_dst)
+    pallas_tb: int | None = None,   # static: VMEM-kernel tile height —
+                                    # selects the Pallas slot pass
+    pallas_emulate: bool = False,   # static: jnp emulation (off-TPU CI)
+    pallas_cclasses: tuple | None = None,  # static: combined tile classes
+                                    # ((T, Emax, kern), ...)
     axis_name: str = AXIS,
     halo_carry=None,              # stale-halo carries (trainer contract slot)
     collect_stabilizers: bool = False,  # static: also return the per-layer
@@ -748,7 +832,36 @@ def gat_forward_local(
     if comm_schedule not in ("a2a", "ragged"):
         raise ValueError(f"unknown comm_schedule {comm_schedule!r} "
                          "(the trainer resolves 'auto' before the forward)")
-    if comm_schedule == "ragged":
+    cell_arrays = (pa.get("cell_idx"), pa.get("cell_w"),
+                   pa.get("ctail_dst"), pa.get("ctail_src"),
+                   pa.get("ctail_w"))
+    if pallas_tb is not None:
+        # VMEM-kernel slot pass (schedule-agnostic, docs/comm_schedule.md):
+        # the cell_idx/cell_w/ctail_dst slots of the layer signature carry
+        # the combined TILE arrays; the tail slots ride unused dummies (the
+        # tiles already cover every combined edge, hub tail included)
+        if not symmetric:
+            raise ValueError(
+                "the Pallas GAT slot pass rides the symmetric custom "
+                "backward; asymmetric plans run the slot-pass path")
+        pspec = (int(pallas_tb), pallas_cclasses, bool(pallas_emulate))
+        dummy_i = jnp.zeros((1,), jnp.int32)
+        dummy_f = jnp.zeros((1,), jnp.float32)
+        if comm_schedule == "ragged":
+            if rr_sizes is None:
+                raise ValueError(
+                    "ragged Pallas GAT forward needs the plan's static "
+                    "rr_sizes (CommPlan.ensure_ragged)")
+            comm = ("ragged+pallas", tuple(rr_sizes), pspec)
+            send_idx, halo_src = pa["rsend_idx"], dummy_i
+            csrc = pa["ptile_crsrc"]
+        else:
+            comm = ("a2a+pallas", pspec)
+            send_idx, halo_src = pa["send_idx"], pa["halo_src"]
+            csrc = pa["ptile_csrc"]
+        cell_arrays = (csrc, pa["ptile_cw"], pa["ptile_cld"],
+                       dummy_i, dummy_f)
+    elif comm_schedule == "ragged":
         # per-round ppermute ring: the attention tables ride the plan's
         # model-independent per-vertex layout (rsend_idx/rhalo_dst); same
         # math, f32 bit-identical (tests/test_gat_ragged.py)
@@ -801,8 +914,8 @@ def gat_forward_local(
         h = layer(
             p["w"], p["a1"], p["a2"], h,
             send_idx, halo_src,
-            pa["cell_idx"], pa["cell_w"],
-            pa["ctail_dst"], pa["ctail_src"], pa["ctail_w"],
+            cell_arrays[0], cell_arrays[1],
+            cell_arrays[2], cell_arrays[3], cell_arrays[4],
             pa["row_valid"], cell_buckets, axis_name, comm)
         h = fact(h) if i == nl - 1 else act(h)
         if i < nl - 1:

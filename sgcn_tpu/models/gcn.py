@@ -90,6 +90,9 @@ def gcn_forward_local(
     pallas_tb: int | None = None,       # static: VMEM-kernel tile height —
                                         # selects the Pallas aggregator
     pallas_emulate: bool = False,       # static: jnp emulation (off-TPU shard_map CI)
+    pallas_lclasses: tuple | None = None,  # static: degree-binned local
+                                        # tile classes ((T,Emax,kern), ...)
+    pallas_hclasses: tuple | None = None,  # static: halo tile classes
     halo_dtype: str | None = None,      # static: wire-only exchange dtype
                                         # ('bfloat16' halves ICI bytes;
                                         # tables/activations stay f32 —
@@ -125,7 +128,26 @@ def gcn_forward_local(
     if comm_schedule not in ("a2a", "ragged"):
         raise ValueError(f"unknown comm_schedule {comm_schedule!r} "
                          "(the trainer resolves 'auto' before the forward)")
-    if comm_schedule == "ragged":
+    if symmetric and pallas_tb is not None and comm_schedule == "ragged":
+        # schedule-agnostic Pallas aggregation: the ragged ring's receive
+        # buffers feed the VMEM kernel directly (tile sources re-based to
+        # ring positions at plan time — no HBM halo table; f32
+        # bit-identical to the a2a-pallas flavor)
+        from ..ops.pallas_spmm import pspmm_pallas_ragged
+
+        if rr_sizes is None:
+            raise ValueError(
+                "ragged Pallas GCN forward needs the plan's static "
+                "rr_sizes (CommPlan.ensure_ragged)")
+
+        def agg(x):
+            return pspmm_pallas_ragged(
+                x, pa["rsend_idx"],
+                pa["ptile_lsrc"], pa["ptile_lld"], pa["ptile_lw"],
+                pa["ptile_hrsrc"], pa["ptile_hld"], pa["ptile_hw"],
+                pallas_tb, pallas_lclasses, pallas_hclasses, rr_sizes,
+                pallas_emulate, axis_name, halo_dtype)
+    elif comm_schedule == "ragged":
         # ragged ppermute ring (docs/comm_schedule.md): per-round-sized
         # buffers replace the globally-padded a2a; same math, f32
         # bit-identical by construction (plan-time round-order edge sort)
@@ -156,7 +178,8 @@ def gcn_forward_local(
                 x, pa["send_idx"], pa["halo_src"],
                 pa["ptile_lsrc"], pa["ptile_lld"], pa["ptile_lw"],
                 pa["ptile_hsrc"], pa["ptile_hld"], pa["ptile_hw"],
-                pallas_tb, pallas_emulate, axis_name, halo_dtype)
+                pallas_tb, pallas_lclasses, pallas_hclasses,
+                pallas_emulate, axis_name, halo_dtype)
     elif symmetric:
         if ell_buckets is None:
             raise ValueError(

@@ -56,7 +56,8 @@ PER_CHIP_ARRAY_FIELDS = (
     "ell_idx", "ell_w", "ltail_dst", "ltail_src", "ltail_w", "ltail_nnz",
     "cell_idx", "cell_w", "ctail_dst", "ctail_src", "ctail_w", "ctail_nnz",
     "ptile_lsrc", "ptile_lld", "ptile_lw",
-    "ptile_hsrc", "ptile_hld", "ptile_hw",
+    "ptile_hsrc", "ptile_hld", "ptile_hw", "ptile_hrsrc",
+    "ptile_csrc", "ptile_cld", "ptile_cw", "ptile_crsrc",
     "rsend_idx", "rhalo_dst", "redge_dst", "redge_src", "redge_w",
     "nrep_send_idx", "nrep_send_counts", "nrep_halo_src",
     "rep_slots", "rep_counts", "nrep_rsend_idx", "nrep_rhalo_dst",
@@ -252,17 +253,38 @@ class CommPlan:
     ctail_nnz: np.ndarray | None = None  # (k,) true combined-tail nnz
 
     # Pallas dst-tile layout (lazy, ``ensure_pallas_tiles``): the local-src
-    # and halo-src edge families regrouped into (T, Emax) row tiles for the
-    # VMEM-resident SpMM kernel (``ops/pallas_spmm.py``) — selected by the
-    # trainer when per-chip tables fit the kernel's VMEM budget, which is
-    # exactly what k-way sharding produces as k grows.
+    # and halo-src edge families regrouped into tb-row tiles, tiles binned
+    # into DEGREE-ALIGNED CLASSES (``tile_classes_from_buckets`` over the
+    # plan's ell_buckets histogram) each padded to its OWN Emax_c, stored
+    # FLAT per chip (class c owns the next T_c·Emax_c slots) with the
+    # static structure in ``pallas_lclasses``/``pallas_hclasses`` — for
+    # the VMEM-resident SpMM kernel (``ops/pallas_spmm.py``), selected by
+    # the trainer when per-chip tables fit the kernel's VMEM budget, which
+    # is exactly what k-way sharding produces as k grows.  The ragged
+    # variant (``ensure_pallas_ragged_tiles``) re-bases the halo tile
+    # sources from halo RANKS to RING positions (the round-major receive
+    # concat of the ppermute ring), so the kernel folds receive buffers
+    # directly — no HBM halo table.  The combined-edge family
+    # (``ensure_pallas_cell_tiles``, GAT) carries 0/1 MASK weights
+    # (attention ignores Â's values) over [local ‖ halo] sources.
     pallas_tb: int | None = None          # static tile height
-    ptile_lsrc: np.ndarray | None = None  # (k, T, EmaxL) int32
-    ptile_lld: np.ndarray | None = None   # (k, T, EmaxL) int32 local dst
-    ptile_lw: np.ndarray | None = None    # (k, T, EmaxL) float32
-    ptile_hsrc: np.ndarray | None = None  # (k, T, EmaxH) int32 (halo block)
-    ptile_hld: np.ndarray | None = None   # (k, T, EmaxH) int32
-    ptile_hw: np.ndarray | None = None    # (k, T, EmaxH) float32
+    pallas_lclasses: tuple | None = None  # ((T_c, Emax_c), ...) local
+    pallas_hclasses: tuple | None = None  # ((T_c, Emax_c), ...) halo
+    ptile_lsrc: np.ndarray | None = None  # (k, ΣT_c·Emax_c) int32
+    ptile_lld: np.ndarray | None = None   # (k, ΣT_c·Emax_c) int32 local dst
+    ptile_lw: np.ndarray | None = None    # (k, ΣT_c·Emax_c) float32
+    ptile_hsrc: np.ndarray | None = None  # (k, ΣT_c·Emax_c) int32 halo rank
+    ptile_hld: np.ndarray | None = None   # (k, ΣT_c·Emax_c) int32
+    ptile_hw: np.ndarray | None = None    # (k, ΣT_c·Emax_c) float32
+    ptile_hrsrc: np.ndarray | None = None  # (k, ΣT_c·Emax_c) int32 RING pos
+    pallas_ctb: int | None = None          # static combined tile height
+    pallas_cclasses: tuple | None = None   # ((T_c, Emax_c), ...) combined
+    ptile_csrc: np.ndarray | None = None   # (k, ·) int32 src in [0, B+R)
+    ptile_cld: np.ndarray | None = None    # (k, ·) int32 local dst
+    ptile_cw: np.ndarray | None = None     # (k, ·) float32 0/1 edge mask
+    ptile_crsrc: np.ndarray | None = None  # (k, ·) int32 src in
+    #                                        [0, B+ΣS_d): halo part re-based
+    #                                        to B + ring position
 
     # Ragged ppermute-ring exchange layout (lazy, ``ensure_ragged``): the
     # reference's point-to-point halo protocol re-expressed as k−1 rounds of
@@ -345,38 +367,131 @@ class CommPlan:
     # talks to itself at column i.  None = the full square plan.
     chip_ids: np.ndarray | None = None
 
+    def _pallas_family(self, dst, src, w, tb: int, class_tiles):
+        """Stack one edge family's per-chip tile classes into flat
+        ``(k, ΣT_c·Emax_c)`` arrays (per class, Emax_c padded to the max
+        across chips so the arrays shard) + the static class structure."""
+        from ..ops.pallas_spmm import build_dst_tile_classes
+
+        per = [build_dst_tile_classes(dst[p], src[p], w[p], self.b, tb,
+                                      class_tiles)
+               for p in range(self.k)]
+        fills = (0, tb - 1, 0.0)           # src, local dst, weight pads
+        dtypes = (np.int32, np.int32, np.float32)
+        flats: list[list] = [[], [], []]
+        classes = []
+        for c, tc in enumerate(class_tiles):
+            emax = max(x[c][0].shape[1] for x in per)
+            classes.append((int(tc), int(emax)))
+            for i in range(3):
+                flats[i].append(np.stack([
+                    np.pad(x[c][i], ((0, 0), (0, emax - x[c][i].shape[1])),
+                           constant_values=fills[i]).astype(dtypes[i])
+                    .reshape(-1) for x in per]))
+        return tuple(np.concatenate(f, axis=1) for f in flats) \
+            + (tuple(classes),)
+
     def ensure_pallas_tiles(self, tb: int = 256) -> "CommPlan":
         """Build the Pallas dst-tile layout on first use.
 
-        Per chip, ``build_dst_tiles`` regroups the dst-sorted local-src and
-        halo-src edge lists into ``tb``-row tiles; Emax is then padded to
-        the max across chips so the arrays stack into the usual (k, ...)
-        sharded form.  Padding edges carry weight 0 (no-ops in the kernel).
+        Per chip, ``build_dst_tile_classes`` regroups the dst-sorted
+        local-src and halo-src edge lists into ``tb``-row tiles binned
+        into degree-aligned classes (``tile_classes_from_buckets`` over
+        ``ell_buckets`` — each class pads to its OWN Emax_c instead of the
+        hub tile's global max); per class, Emax_c is padded to the max
+        across chips so the flat arrays stack into the usual (k, ...)
+        sharded form.  Padding edges carry weight 0 (no-ops in the
+        kernel).
         """
         if self.pallas_tb == tb and self.ptile_lsrc is not None:
             return self
-        from ..ops.pallas_spmm import build_dst_tiles
+        from ..ops.pallas_spmm import tile_classes_from_buckets
 
-        def family(dst, src, w):
-            per = [build_dst_tiles(dst[p], src[p], w[p], self.b, tb=tb)[:3]
-                   for p in range(self.k)]
-            emax = max(x[0].shape[1] for x in per)
-
-            def padcat(i, dtype, fill):
-                return np.stack([
-                    np.pad(x[i], ((0, 0), (0, emax - x[i].shape[1])),
-                           constant_values=fill).astype(dtype)
-                    for x in per])
-
-            # pad src with 0 (weight-0), local dst with tb-1 (kernel pad row)
-            return (padcat(0, np.int32, 0), padcat(1, np.int32, tb - 1),
-                    padcat(2, np.float32, 0.0))
-
-        self.ptile_lsrc, self.ptile_lld, self.ptile_lw = family(
-            self.ledge_dst, self.ledge_src, self.ledge_w)
-        self.ptile_hsrc, self.ptile_hld, self.ptile_hw = family(
-            self.hedge_dst, self.hedge_src, self.hedge_w)
+        class_tiles = tile_classes_from_buckets(self.ell_buckets, self.b, tb)
+        (self.ptile_lsrc, self.ptile_lld, self.ptile_lw,
+         self.pallas_lclasses) = self._pallas_family(
+            self.ledge_dst, self.ledge_src, self.ledge_w, tb, class_tiles)
+        (self.ptile_hsrc, self.ptile_hld, self.ptile_hw,
+         self.pallas_hclasses) = self._pallas_family(
+            self.hedge_dst, self.hedge_src, self.hedge_w, tb, class_tiles)
         self.pallas_tb = tb
+        self.ptile_hrsrc = None            # ring re-base follows the layout
+        return self
+
+    def _ring_pos_of_rank(self) -> np.ndarray:
+        """(k, R+1) map halo rank → position in the ragged ring's
+        round-major receive concat (``ensure_ragged``'s rhalo_dst,
+        inverted; the extra slot absorbs the pad rank R)."""
+        if self.rhalo_dst is None:
+            raise ValueError(
+                "ring positions need the ragged layout (ensure_ragged)")
+        st = self.rsend_idx.shape[1]
+        pos = np.zeros((self.k, self.r + 1), np.int64)
+        ar = np.arange(st)
+        for p in range(self.k):
+            pos[p, self.rhalo_dst[p]] = ar
+        return pos
+
+    def ensure_pallas_ragged_tiles(self) -> "CommPlan":
+        """Re-base the halo tile sources from halo RANKS to RING positions
+        (``ptile_hrsrc``) so the Pallas kernel reads the ppermute ring's
+        round-major receive concat directly — same tiles, same per-tile
+        edge order as the a2a flavor's, which is the f32 bit-parity
+        contract of ``pspmm_pallas_ragged``; no (R, f) halo table is ever
+        materialized.  Needs ``ensure_pallas_tiles`` + ``ensure_ragged``.
+        """
+        if self.ptile_hrsrc is not None:
+            return self
+        if self.ptile_hsrc is None:
+            raise ValueError(
+                "ragged pallas tiles need the tile layout first "
+                "(ensure_pallas_tiles)")
+        pos = self._ring_pos_of_rank()
+        self.ptile_hrsrc = np.stack([
+            pos[p][self.ptile_hsrc[p]] for p in range(self.k)
+        ]).astype(np.int32)
+        return self
+
+    def ensure_pallas_cell_tiles(self, tb: int = 256) -> "CommPlan":
+        """Build the COMBINED-edge Pallas tile layout on first use (GAT):
+        the ``[local ‖ halo]``-sourced edge family in the same
+        degree-binned tile classes (histogram: ``cell_buckets``), with 0/1
+        MASK weights — the GAT slot passes aggregate by edge presence, not
+        Â's values (``models/gat.py``)."""
+        if self.pallas_ctb == tb and self.ptile_csrc is not None:
+            return self
+        from ..ops.pallas_spmm import tile_classes_from_buckets
+
+        self.ensure_cell()
+        class_tiles = tile_classes_from_buckets(self.cell_buckets, self.b,
+                                                tb)
+        mask = (np.asarray(self.edge_w) != 0).astype(np.float32)
+        (self.ptile_csrc, self.ptile_cld, self.ptile_cw,
+         self.pallas_cclasses) = self._pallas_family(
+            self.edge_dst, self.edge_src, mask, tb, class_tiles)
+        self.pallas_ctb = tb
+        self.ptile_crsrc = None            # ring re-base follows the layout
+        return self
+
+    def ensure_pallas_cell_ragged_tiles(self) -> "CommPlan":
+        """Combined-tile sources for the ragged ring: local sources stay,
+        halo sources (≥ B) re-base to ``B +`` their ring position — the
+        kernel table is ``[local table ‖ ring concat]``, no halo-table
+        scatter (cf. ``ensure_pallas_ragged_tiles``)."""
+        if self.ptile_crsrc is not None:
+            return self
+        if self.ptile_csrc is None:
+            raise ValueError(
+                "ragged pallas cell tiles need the combined tile layout "
+                "first (ensure_pallas_cell_tiles)")
+        pos = self._ring_pos_of_rank()
+        out = []
+        for p in range(self.k):
+            src = self.ptile_csrc[p]
+            halo = src >= self.b
+            out.append(np.where(halo, self.b + pos[p][np.where(
+                halo, src - self.b, 0)], src))
+        self.ptile_crsrc = np.stack(out).astype(np.int32)
         return self
 
     def ensure_cell(self, buckets: tuple | None = None,
@@ -1069,9 +1184,11 @@ def resolve_comm_schedule(schedule: str | None, plans, model: str,
     * **exact mode** (``halo_staleness=0``): the latency trade — the ring
       issues k−1 collectives where the dense schedule issues one, so ragged
       only pays when the aggregate dense padding efficiency falls below
-      ``RAGGED_AUTO_EFFICIENCY``, AND the choice must not forfeit the
-      Pallas VMEM aggregator (GCN only — the ragged fold is pinned to the
-      ELL path; GAT has no VMEM aggregator to forfeit).
+      ``RAGGED_AUTO_EFFICIENCY``.  (The Pallas VMEM aggregator is
+      schedule-agnostic since ``pspmm_pallas_ragged`` — the old "ragged
+      forfeits the VMEM kernel" carve-out is gone: kernel choice is made
+      per degree bucket AFTER the transport is picked,
+      ``ops/pallas_spmm.py::choose_pallas_dispatch``.)
     * **stale mode** (``halo_staleness=1``): the exchange is HIDDEN — no
       same-step consumer, so its latency (the k−1 dispatches included) is
       off the critical path and the padding-efficiency threshold would be
@@ -1165,15 +1282,9 @@ def resolve_comm_schedule(schedule: str | None, plans, model: str,
                                "ships no fewer wire rows")
     if not wire or true / wire >= RAGGED_AUTO_EFFICIENCY:
         return resolved("a2a", "padding efficiency at/above threshold")
-    if (model == "gcn" and fin is not None and widths is not None
-            and not replica_budget):
-        # replica runs never select the Pallas aggregator (the replica
-        # carry contract is built around the ELL + hedge fold), so there
-        # is no VMEM kernel to forfeit on that path
-        from ..ops.pallas_spmm import use_pallas_spmm   # deferred: jax
-        if use_pallas_spmm(plans[0], fin, widths):
-            return resolved("a2a", "Pallas VMEM aggregator would be "
-                                   "forfeited (GCN exception)")
+    # no Pallas exception: the VMEM aggregator rides BOTH transports since
+    # pspmm_pallas_ragged (schedule-agnostic kernel family; per-bucket
+    # kernel choice happens after transport selection)
     return resolved("ragged", "padding efficiency below threshold")
 
 

@@ -102,9 +102,12 @@ class ForwardSetup:
             # mask on w != 0: plan padding carries weight exactly 0 by
             # construction, so every real edge survives even for a signed/
             # unnormalized weighted graph (ADVICE r4 — `> 0` dropped
-            # negative-weight edges)
-            for f in ("cell_w", "ctail_w"):
-                arrays[f] = (arrays[f] != 0).astype(np.int8)
+            # negative-weight edges).  The Pallas field set's plan-time 0/1
+            # mask tiles (ptile_cw) narrow the same way — gat_pallas_pass
+            # upcasts in-program, exactly like the slot passes.
+            for f in ("cell_w", "ctail_w", "ptile_cw"):
+                if f in arrays:
+                    arrays[f] = (arrays[f] != 0).astype(np.int8)
         return arrays
 
 
@@ -115,7 +118,8 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
                           halo_staleness: int = 0,
                           replica_budget: int | str = 0,
                           refresh_band: float | None = None,
-                          serve_subgraph: bool = False
+                          serve_subgraph: bool = False,
+                          allow_pallas: bool = True
                           ) -> ForwardSetup:
     """Resolve (schedule, shipped plan fields, static forward kwargs) for one
     plan — the selection logic that used to live inline in
@@ -128,7 +132,11 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
     passes it): it swaps the shipped fields for the replica union tuples —
     ``fwd_static`` stays the EXACT forward's statics, because evaluation
     and serving ride ``gcn_forward_local`` on the same (superset) plan
-    arrays, with jit pruning the ``nrep_*`` half."""
+    arrays, with jit pruning the ``nrep_*`` half.  ``allow_pallas=False``
+    keeps the selection on the slot-pass/ELL aggregators regardless of the
+    VMEM-fit rule — the mini-batch trainer's ONE compiled step must serve
+    every per-batch plan, and the Pallas tile layout (per-class Emax_c
+    statics, tiles built per plan) has no shared-envelope form."""
     from ..parallel.plan import choose_replica_budget, resolve_comm_schedule
 
     decision: dict = {}
@@ -160,11 +168,11 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
     plan_fields = fields_fn(plan)
     fwd_static = static_fn(plan)
     if model == "gcn" and comm_schedule == "ragged":
-        # the ragged schedule stays on the ELL aggregator (its fold
-        # contract is built around the per-owner edge split; the Pallas
-        # tile layout is a dense-a2a companion) — mirror of the stale
-        # mode's aggregator pin below.  The composed (stale × ragged)
-        # step ships the same ring arrays under its own contract tuple.
+        # the ragged ELL aggregation path (fold-as-you-arrive scatter over
+        # the per-owner edge split); the Pallas selection below may swap
+        # it for the schedule-agnostic VMEM kernel family.  The composed
+        # (stale × ragged) step ships the same ring arrays under its own
+        # contract tuple.
         from ..models.gcn import GCN_PLAN_FIELDS_RAGGED
         from ..parallel.plan import STALE_PLAN_FIELDS_RAGGED
         plan_fields = (STALE_PLAN_FIELDS_RAGGED if halo_staleness
@@ -201,34 +209,6 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
             plan_fields = (REPLICA_PLAN_FIELDS_RAGGED
                            if comm_schedule == "ragged"
                            else REPLICA_PLAN_FIELDS)
-    if model == "gcn" and not halo_staleness and not replica_budget \
-            and comm_schedule == "a2a":
-        # plan-driven kernel choice (VERDICT r3 #9): per-chip tables in
-        # the VMEM regime switch the aggregator to the Pallas kernel.
-        # The stale mode stays on the ELL aggregator: pspmm_stale's
-        # carry contract is built around it, and hiding the exchange
-        # removes the latency the VMEM kernel would have overlapped; the
-        # replica mode likewise — its halo-table assembly and carry
-        # contract are built around the ELL + hedge fold.
-        from ..ops.pallas_spmm import PALLAS_PLAN_FIELDS, use_pallas_spmm
-        if use_pallas_spmm(plan, fin, widths):
-            if serve_subgraph:
-                # the sub-graph serve engine's compact mirror reproduces
-                # the ELL fold's per-row chains (serve/subgraph.py); the
-                # Pallas tile fold has a different per-row addition
-                # sequence, so bit-parity would silently break — refuse
-                # here, in the ONE selection-rule home, rather than in
-                # the engine
-                raise ValueError(
-                    "sub-graph serving reproduces the ELL fold; this plan "
-                    "resolved to the Pallas VMEM aggregator — serve with "
-                    "mode='full' or set SGCN_PALLAS_SPMM=0")
-            plan.ensure_pallas_tiles()
-            plan_fields = PALLAS_PLAN_FIELDS
-            fwd_static = {
-                "pallas_tb": plan.pallas_tb,
-                "pallas_emulate": jax.default_backend() != "tpu",
-            }
     if model == "gat" and comm_schedule == "ragged":
         # the attention tables ride the plan's model-independent
         # per-vertex ring layout (rsend_idx/rhalo_dst); the combined
@@ -240,6 +220,63 @@ def resolve_forward_setup(plan: "CommPlan", fin: int, widths,
                           comm_schedule="ragged",
                           rr_sizes=plan.rr_sizes,
                           halo_r=plan.r)
+    if not halo_staleness and not replica_budget and allow_pallas:
+        # plan-driven kernel choice (VERDICT r3 #9, schedule- and
+        # model-agnostic since ISSUE 15): per-chip tables in the VMEM
+        # regime switch the aggregator to the Pallas kernel family, on
+        # EITHER transport and for BOTH models, with the kernel picked
+        # per degree-binned tile class (choose_pallas_dispatch — hub
+        # classes may stay on the XLA gather form while the dense
+        # low-degree mass rides VMEM; the per-bucket decision lands in
+        # the manifest decision log).  The stale mode stays on the ELL
+        # aggregator: pspmm_stale's carry contract is built around it,
+        # and hiding the exchange removes the latency the VMEM kernel
+        # would have overlapped; the replica mode likewise — its
+        # halo-table assembly and carry contract are built around the
+        # ELL + hedge fold; the mini-batch trainer passes
+        # allow_pallas=False (one compiled step, many per-batch plans —
+        # see the docstring).
+        from ..ops.pallas_spmm import (PALLAS_PLAN_FIELDS,
+                                       PALLAS_PLAN_FIELDS_RAGGED,
+                                       choose_pallas_dispatch,
+                                       use_pallas_spmm)
+        if use_pallas_spmm(plan, fin, widths, model=model,
+                           compute_dtype=compute_dtype,
+                           schedule=comm_schedule):
+            if serve_subgraph:
+                # the sub-graph serve engine's compact mirror reproduces
+                # the ELL fold's per-row chains (serve/subgraph.py); the
+                # Pallas tile fold has a different per-row addition
+                # sequence, so bit-parity would silently break — refuse
+                # here, in the ONE selection-rule home, rather than in
+                # the engine
+                raise ValueError(
+                    "sub-graph serving reproduces the ELL fold; this plan "
+                    "resolved to the Pallas VMEM aggregator — serve with "
+                    "mode='full' or set SGCN_PALLAS_SPMM=0")
+            pallas_static = choose_pallas_dispatch(
+                plan, model=model, schedule=comm_schedule,
+                decision=decision)
+            pallas_static["pallas_emulate"] = \
+                jax.default_backend() != "tpu"
+            if model == "gat":
+                from ..models.gat import (GAT_PLAN_FIELDS_PALLAS,
+                                          GAT_PLAN_FIELDS_PALLAS_RAGGED)
+                plan_fields = (GAT_PLAN_FIELDS_PALLAS_RAGGED
+                               if comm_schedule == "ragged"
+                               else GAT_PLAN_FIELDS_PALLAS)
+                fwd_static = dict(
+                    cell_buckets=plan.cell_buckets, **pallas_static)
+            else:
+                plan_fields = (PALLAS_PLAN_FIELDS_RAGGED
+                               if comm_schedule == "ragged"
+                               else PALLAS_PLAN_FIELDS)
+                fwd_static = dict(pallas_static)
+            if comm_schedule == "ragged":
+                # both models thread the same static ring spec (the ring
+                # concat needs only rr_sizes — no redge fold, no halo_r)
+                fwd_static.update(comm_schedule="ragged",
+                                  rr_sizes=plan.rr_sizes)
     return ForwardSetup(model=model, comm_schedule=comm_schedule,
                         plan_fields=plan_fields, fwd_static=fwd_static,
                         forward_fn=forward_fn, init_fn=init_fn,
@@ -380,6 +417,7 @@ class FullBatchTrainer:
         replica_budget: int | str = 0,
         refresh_band: float | None = None,
         auto_tune_sync: bool = False,
+        allow_pallas: bool = True,
     ):
         """``compute_dtype='bfloat16'`` runs forward/backward (including the
         halo exchange — half the ICI bytes) in bf16 with f32 master params
@@ -536,16 +574,18 @@ class FullBatchTrainer:
                     "step); drop compute_dtype/remat or run exact mode")
         # ONE selection rule for both trainers AND the serve engine
         # (resolve_forward_setup → parallel/plan.py::resolve_comm_schedule):
-        # 'auto' silently prefers ragged on skewed plans unless that
-        # forfeits the Pallas VMEM aggregator; an explicit 'ragged' is a
-        # contract, validated loudly inside the resolver.  Composition with
-        # halo_staleness=1 is SUPPORTED (the round-structured carry of
+        # 'auto' silently prefers ragged on skewed plans (the kernel family
+        # is schedule-agnostic since ISSUE 15, so the transport choice no
+        # longer forfeits the Pallas VMEM aggregator); an explicit 'ragged'
+        # is a contract, validated loudly inside the resolver.  Composition
+        # with halo_staleness=1 is SUPPORTED (the round-structured carry of
         # pspmm_stale_ragged); the staleness gates above (GCN, symmetric,
         # f32 non-remat) already cover the genuinely unsupported combos.
         setup = resolve_forward_setup(
             plan, fin, widths, model=model, comm_schedule=comm_schedule,
             compute_dtype=compute_dtype, halo_staleness=halo_staleness,
-            replica_budget=replica_budget, refresh_band=refresh_band)
+            replica_budget=replica_budget, refresh_band=refresh_band,
+            allow_pallas=allow_pallas)
         self.comm_decision = setup.decision   # selection → run manifest
         comm_schedule = setup.comm_schedule
         replica_budget = setup.replica_budget   # 'auto' -> the knee B

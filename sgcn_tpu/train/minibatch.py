@@ -166,12 +166,18 @@ class MiniBatchTrainer:
                     p.ensure_ragged(rr_sizes=shared_s,
                                     rr_edge_sizes=shared_e)
 
-        # one inner trainer = one compiled step for every batch
+        # one inner trainer = one compiled step for every batch.
+        # allow_pallas=False: the VMEM kernel family's tile layout is
+        # per-plan (per-class Emax_c statics, ptile_* arrays built by
+        # ensure_pallas_tiles) — plans[0]'s compiled step cannot serve the
+        # other batches' plans, whose tile arrays would never be built, so
+        # the shared envelope stays on the slot-pass/ELL aggregators
         self.inner = FullBatchTrainer(
             self.plans[0], fin, widths, mesh=self.mesh, lr=lr,
             activation=activation, model=model, loss=loss,
             optimizer=optimizer, seed=seed,
-            compute_dtype=compute_dtype, comm_schedule=comm_schedule)
+            compute_dtype=compute_dtype, comm_schedule=comm_schedule,
+            allow_pallas=False)
         # checkpoints save through `inner`, whose plan is a padded per-BATCH
         # plan — its digest varies with batch_size/nbatches/pad envelope, so
         # it is not a stable run identity; suppress it (utils/checkpoint.py

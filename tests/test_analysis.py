@@ -85,7 +85,15 @@ def test_matrix_covers_the_advertised_axes(full_report):
             "train/gcn/ragged/s1/f32/rep", "train/gcn/ragged/s1/bf16/rep",
             "train/gcn/ragged/s0/f32@banded",
             "train/gcn/ragged/s1/f32@banded",
-            "train/gcn/ragged/s1/f32/rep@banded"):
+            "train/gcn/ragged/s1/f32/rep@banded",
+            # the schedule-/model-agnostic Pallas kernel family (ISSUE 15)
+            "train/gcn/a2a/s0/f32/pallas", "train/gcn/a2a/s0/bf16/pallas",
+            "train/gcn/ragged/s0/f32/pallas",
+            "train/gcn/ragged/s0/bf16/pallas",
+            "train/gat/a2a/fused/pallas", "train/gat/a2a/split/pallas",
+            "train/gat/ragged/fused/pallas",
+            "train/gat/ragged/split/pallas",
+            "train/gcn/ragged/s0/f32/pallas@banded"):
         assert required in ids, f"mode {required} missing from the audit"
 
 

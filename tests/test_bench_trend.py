@@ -191,6 +191,36 @@ def test_gate_zero_band_for_deterministic_counters(tmp_path):
     assert not check_series(extract_series(load_history(root2))[0])
 
 
+def test_pallas_ragged_counters_registered_zero_band(tmp_path):
+    """The kernel × schedule A/B counters (ISSUE 15) register as zero-band
+    series scoped on (n, graph, k); the zero-halo-table contract of the
+    pallas ragged arm is literally a zero that may never move."""
+    def _prab(halo_bytes):
+        return {"pallas_ragged_ab_8dev": {
+            "n": 12000, "graph": "ba", "k": 8,
+            "ell_ragged": {"epoch_s": 0.1, "measured": True,
+                           "wire_rows_per_exchange": 24096,
+                           "halo_table_bytes_per_step": 0},
+            "pallas_ragged": {"epoch_s": 0.2, "measured": True,
+                              "wire_rows_per_exchange": 24096,
+                              "halo_table_bytes_per_step": halo_bytes},
+            "pallas_a2a": {"epoch_s": 0.2, "measured": True,
+                           "wire_rows_per_exchange": 28736,
+                           "halo_table_bytes_per_step": 1000}}}
+
+    root = _write_history(tmp_path, [
+        (1, _rec(0.05, **_prab(0))), (2, _rec(0.05, **_prab(4096)))])
+    series, _ = extract_series(load_history(root))
+    key = [k for k in series
+           if k[1] == "pallas_ragged_pallas_ragged_halo_table_bytes_per_step"]
+    assert key and series[key[0]] == [(1, 0.0), (2, 4096.0)]
+    problems = check_series(series)
+    assert any("halo_table_bytes_per_step" in p and "never regress" in p
+               for p in problems)
+    # emulate-mode epoch times are NOT tracked series (never a CPU claim)
+    assert not any("pallas" in k[1] and "epoch" in k[1] for k in series)
+
+
 def test_cli_check_mode_exit_codes(tmp_path):
     """--check is the gate (rc 1 on violation); report mode always rc 0."""
     root = _write_history(tmp_path, [(1, _rec(0.10)), (2, _rec(0.90))])

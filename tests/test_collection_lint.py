@@ -69,8 +69,9 @@ SUBPROCESS_BUDGET_ALLOWLIST = {
 # single one-program .lower() is cheap and not gated; the matrix sweep is
 # the class that can silently eat the tier-1 budget as modes are added.
 MATRIX_AUDIT_BUDGET_ALLOWLIST = {
-    "test_analysis.py": "ONE module-scoped full-matrix run (~75 s at "
-                        "HEAD, 27 mode entries, lowering only — no "
+    "test_analysis.py": "ONE module-scoped full-matrix run (~130 s at "
+                        "PR-15 HEAD, 48 mode entries incl. the eight "
+                        "pallas modes, lowering only — no "
                         "compile/execute) shared by every matrix "
                         "assertion, plus per-mode mutation audits "
                         "(~2-4 s each)",

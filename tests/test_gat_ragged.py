@@ -134,17 +134,19 @@ def test_auto_model_agnostic_select(skewplan, cora8):
         assert tr_b.comm_schedule == "a2a"
 
 
-def test_auto_pallas_vmem_exception_stays_gcn_only(skewplan, monkeypatch):
-    """On the same skewed plan, GCN-auto in the (forced) Pallas-VMEM regime
-    resolves to a2a — the ragged fold pins the ELL aggregator — while
-    GAT-auto has no VMEM aggregator to forfeit and keeps ragged."""
+def test_auto_keeps_ragged_in_pallas_regime(skewplan, monkeypatch):
+    """The old GCN-only VMEM exception is GONE (ISSUE 15): the Pallas
+    aggregator is schedule-agnostic (``pspmm_pallas_ragged``), so on the
+    same skewed plan 'auto' keeps ragged for BOTH models even with the
+    kernel forced on — the transport and the kernel are now independent
+    choices (kernel per degree bucket, after the transport resolves)."""
     from sgcn_tpu.ops.pallas_spmm import use_pallas_spmm
     from sgcn_tpu.parallel.plan import resolve_comm_schedule
 
     monkeypatch.setenv("SGCN_PALLAS_SPMM", "1")
     assert use_pallas_spmm(skewplan, 12, [8, 4])
     assert resolve_comm_schedule("auto", [skewplan], "gcn",
-                                 fin=12, widths=[8, 4]) == "a2a"
+                                 fin=12, widths=[8, 4]) == "ragged"
     assert resolve_comm_schedule("auto", [skewplan], "gat",
                                  fin=12, widths=[8, 4]) == "ragged"
 

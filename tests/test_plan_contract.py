@@ -54,6 +54,9 @@ def _full_plan():
     plan.ensure_cell()
     plan.ensure_pallas_tiles(tb=64)
     plan.ensure_ragged()
+    plan.ensure_pallas_ragged_tiles()
+    plan.ensure_pallas_cell_tiles(tb=64)
+    plan.ensure_pallas_cell_ragged_tiles()
     plan.ensure_replicas(12)
     return plan
 
@@ -97,8 +100,10 @@ def test_shipped_field_tuples_are_sliceable():
 
     plan = _full_plan()
     proxy = shard_proxy_plan(plan, chip=1)      # raises on any drift
-    for tup_name in ("PALLAS_PLAN_FIELDS", "GAT_PLAN_FIELDS",
-                     "GAT_PLAN_FIELDS_RAGGED",
+    for tup_name in ("PALLAS_PLAN_FIELDS", "PALLAS_PLAN_FIELDS_RAGGED",
+                     "GAT_PLAN_FIELDS", "GAT_PLAN_FIELDS_RAGGED",
+                     "GAT_PLAN_FIELDS_PALLAS",
+                     "GAT_PLAN_FIELDS_PALLAS_RAGGED",
                      "GCN_PLAN_FIELDS_SYM", "GCN_PLAN_FIELDS_GEN",
                      "GCN_PLAN_FIELDS_RAGGED", "STALE_PLAN_FIELDS_RAGGED"):
         for f in CONSUMER_TUPLES[tup_name]:

@@ -15,9 +15,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from validate_bench import (check_bench_record, check_multichip_record,  # noqa: E402
-                            check_products_ksweep, check_ragged_ab,
-                            check_ragged_stale_ab, check_serve_qps,
-                            validate_tree)
+                            check_pallas_ragged_ab, check_products_ksweep,
+                            check_ragged_ab, check_ragged_stale_ab,
+                            check_serve_qps, validate_tree)
 
 
 def test_checked_in_artifacts_validate():
@@ -168,6 +168,60 @@ def test_validator_ragged_stale_ab_contract():
         {"ragged_stale_ab_8dev": no_note}))
     assert any("missing arm" in e for e in check_ragged_stale_ab(
         {"ragged_stale_ab_8dev": {"arms": {"a2a_stale": _rsab_arm(1, 10)}}}))
+
+
+def _prab_arm(wire, halo_bytes, **over):
+    a = {"epoch_s": 0.1, "measured": True,
+         "wire_rows_per_exchange": wire,
+         "halo_table_bytes_per_step": halo_bytes}
+    a.update(over)
+    return a
+
+
+def _prab_block(**over):
+    b = {"n": 12000, "graph": "ba", "k": 8,
+         "timing": "EMULATE-mode kernels; epoch speed is reported "
+                   "honestly but is never the claim",
+         "ell_ragged": _prab_arm(24096, 0),
+         "pallas_ragged": _prab_arm(24096, 0),
+         "pallas_a2a": _prab_arm(28736, 37011456)}
+    b.update(over)
+    return b
+
+
+def test_validator_pallas_ragged_ab_contract():
+    """The kernel × schedule block (ISSUE 15): null needs a degradation
+    marker; the pallas ragged arm must ship the ELL arm's EXACT wire,
+    strictly below the a2a pad, and book zero halo-table bytes; epoch
+    times need measured provenance and the honest note."""
+    assert any("degraded" in e for e in check_pallas_ragged_ab(
+        {"pallas_ragged_ab_8dev": None}))
+    assert not check_pallas_ragged_ab(
+        {"pallas_ragged_ab_8dev": None,
+         "pallas_ragged_ab_degraded": "deadline"})
+    assert not check_pallas_ragged_ab(
+        {"pallas_ragged_ab_8dev": _prab_block()})
+    # kernel silently changed the transport (different wire)
+    drift = _prab_block(pallas_ragged=_prab_arm(20000, 0))
+    assert any("must not touch the transport" in e
+               for e in check_pallas_ragged_ab(
+                   {"pallas_ragged_ab_8dev": drift}))
+    # halo table crept back into the ragged arm
+    crept = _prab_block(pallas_ragged=_prab_arm(24096, 4096))
+    assert any("ZERO HBM halo-table" in e for e in check_pallas_ragged_ab(
+        {"pallas_ragged_ab_8dev": crept}))
+    # the a2a arm's analytic model must book a positive figure
+    broke = _prab_block(pallas_a2a=_prab_arm(28736, 0))
+    assert any("analytic model broke" in e for e in check_pallas_ragged_ab(
+        {"pallas_ragged_ab_8dev": broke}))
+    # provenance + honest note
+    unprov = _prab_block(ell_ragged=_prab_arm(24096, 0, measured=False))
+    assert any("measured" in e for e in check_pallas_ragged_ab(
+        {"pallas_ragged_ab_8dev": unprov}))
+    assert any("honest-measurement" in e for e in check_pallas_ragged_ab(
+        {"pallas_ragged_ab_8dev": _prab_block(timing="timings")}))
+    assert any("missing" in e for e in check_pallas_ragged_ab(
+        {"pallas_ragged_ab_8dev": {"timing": "never the claim"}}))
 
 
 def _replica_cfg(true_total_rep=900, wire_step_rep=80.0, **over):
@@ -456,7 +510,7 @@ def test_validator_cli_exit_codes(tmp_path):
     assert "violation" in r.stdout
 
 
-def _clean_analysis_report(n_modes=39):
+def _clean_analysis_report(n_modes=48):
     modes = {
         f"train/gcn/a2a/s0/m{i}": {
             "ok": True,
