@@ -1806,6 +1806,58 @@ def shard_epoch_model_block() -> dict:
     return {"epoch_s_8chip_model": block} if block else {}
 
 
+def memory_footprint_block(n: int, avg_deg: int, f: int, widths,
+                           graph: str = "ba", k: int = 8) -> dict:
+    """Analytic per-chip HBM footprint gauges (the ``memory_footprint_8dev``
+    block, ISSUE 18): the plan-derived residency model of
+    ``sgcn_tpu.obs.memory`` evaluated for a representative mode set on the
+    8-chip diagnostic shape.  No clock, no compile, no allocator anywhere —
+    every byte count is a pure function of (CommPlan, model config), so
+    ``scripts/bench_trend.py`` registers each (mode, array family) figure
+    as a ZERO-band counter series scoped on (n, nnz, k).  ``analytic:
+    true`` is the provenance flag the memory-provenance rule of
+    ``scripts/validate_bench.py`` requires on residency-byte claims."""
+    block: dict = {"memory_footprint_8dev": None}
+    try:
+        ahat = synth_graph(n, avg_deg, seed=0, kind=graph)
+        from sgcn_tpu.obs.memory import memory_model
+        from sgcn_tpu.parallel import build_comm_plan
+        from sgcn_tpu.partition import balanced_random_partition
+
+        pv = balanced_random_partition(ahat.shape[0], k, seed=1)
+        plan = build_comm_plan(ahat, pv, k)
+        modes = {
+            "train_gcn_a2a": dict(workload="train", model="gcn",
+                                  comm_schedule="a2a"),
+            "train_gcn_ragged": dict(workload="train", model="gcn",
+                                     comm_schedule="ragged"),
+            "train_gcn_ragged_stale": dict(workload="train", model="gcn",
+                                           comm_schedule="ragged",
+                                           halo_staleness=1),
+            "train_gat_a2a": dict(workload="train", model="gat",
+                                  comm_schedule="a2a"),
+            "serve_gcn_ragged": dict(workload="serve", model="gcn",
+                                     comm_schedule="ragged"),
+        }
+        out: dict = {"n": int(ahat.shape[0]), "nnz": int(ahat.nnz),
+                     "k": int(k), "graph": graph, "fin": int(f),
+                     "nlayers": len(widths), "analytic": True, "modes": {}}
+        for mid, kw in modes.items():
+            m = memory_model(plan, f, list(widths), **kw)
+            out["modes"][mid] = {
+                "analytic": True,
+                "model_bytes": int(m.total_bytes),
+                **{f"{name}_bytes": int(v)
+                   for name, v in sorted(m.families.items()) if v},
+            }
+        block["memory_footprint_8dev"] = out
+        return block
+    except Exception as e:                      # noqa: BLE001 — diagnostic path
+        print(f"# memory footprint block failed: {e!r}", file=sys.stderr)
+        block["memory_footprint_degraded"] = repr(e)[:200]
+        return block
+
+
 def products_partition_block() -> dict:
     """Products-scale partitioner evidence (VERDICT r3 item 1): the native
     hypergraph/graph partitioners run OFFLINE on the exact products-shape
@@ -1956,6 +2008,10 @@ def main() -> None:
                    help="skip the full-vs-subgraph serving A/B "
                         "(serve_subgraph_ab_8dev: shared open-loop traffic, "
                         ">=10x analytic per-query FLOP/touched-row cut)")
+    p.add_argument("--skip-memory-footprint", action="store_true",
+                   help="skip the analytic per-chip HBM footprint gauges "
+                        "(memory_footprint_8dev: plan-derived bytes per "
+                        "mode x array family, zero-band trend counters)")
     p.add_argument("--serve-subgraph-n", type=int, default=20_000,
                    help="graph size for the serve subgraph A/B child")
     p.add_argument("--skip-pallas-ragged-ab", action="store_true",
@@ -2288,6 +2344,12 @@ def main() -> None:
     if not args.vdev_child:
         extra.update(products_partition_block())
         extra.update(shard_epoch_model_block())
+        if not args.skip_memory_footprint:
+            # analytic footprint gauges: pure plan math (no child process,
+            # no mesh) — runs for the gat flagship too
+            extra.update(memory_footprint_block(
+                args.vdev_n, args.avg_deg, args.f, widths,
+                graph=args.vdev_graph))
     ab_rev = args.ab_baseline
     if ab_rev is None and args.n >= 1_000_000:
         pin = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -34,12 +34,19 @@ Rules:
     (``COUNTER_KEYS``: ``km1_8dev``, ``comm_volume_rows_8dev``) get a ZERO
     band: they are plan-derived, reproducible bit-for-bit, and may never
     increase within a series.
-  * **Serving series** (PR-8) — the ``serve_qps_8dev`` block's measured
-    latency quantiles / achieved QPS register as REPORT-ONLY series (their
-    non-"s" units keep them outside the lower-is-better time band — a
-    latency gate can be added once rounds establish the band), while the
-    plan-derived per-query/per-exchange wire-row gauges are zero-band
-    counters like ``km1_8dev``.
+  * **Serving series** (PR-8, gate since ISSUE 18) — the
+    ``serve_qps_8dev``/``serve_subgraph_ab_8dev`` arms' measured latency
+    quantiles are GATED with the same median-anchored multiplicative band
+    as the epoch times (latency is lower-is-better by construction; rounds
+    r01–r05 established the anchor per ROADMAP item 3c); achieved QPS
+    stays REPORT-ONLY (it improves upward), and the plan-derived
+    per-query/per-exchange wire-row gauges are zero-band counters like
+    ``km1_8dev``.
+  * **Memory-footprint series** (ISSUE 18) — the ``memory_footprint_8dev``
+    block's analytic per-chip byte counts (per mode, per array family —
+    ``sgcn_tpu.obs.memory``, no clock or allocator anywhere) are ZERO-band
+    counters scoped on the block's (n, nnz, k): a byte that grows at fixed
+    config is a new resident array, not noise.
   * **Degradation-marker aware** — a record with ``rc != 0``, or a null
     ``value`` carrying a ``skipped``/``degraded`` marker, is a GAP in the
     series (reported, never compared): the graceful-degradation contract
@@ -67,12 +74,16 @@ ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 COUNTER_KEYS = ("km1_8dev", "comm_volume_rows_8dev")
 # flagship keys that scope a counter series to one diagnostic config
 _DIAG_CFG_KEYS = ("n_8dev", "graph_8dev", "partitioner_8dev")
-# serving-bench series (PR-8, the serve_qps_8dev block): measured latency
-# quantiles and achieved QPS are REPORT-ONLY at first (registered with a
-# non-"s" unit so the lower-is-better time band never applies — the PR-7
-# unit rule; a gate can be added once a few rounds establish the band),
-# while the plan-derived per-query wire-row gauge is a zero-band counter
-SERVE_REPORT_KEYS = ("latency_p50_ms", "latency_p99_ms", "achieved_qps")
+# serving-bench series (PR-8, the serve_qps_8dev block): achieved QPS is
+# REPORT-ONLY (it improves UPWARD, so the lower-is-better band never
+# applies — the PR-7 unit rule), while the measured latency quantiles are
+# GATED since ISSUE 18 (ROADMAP item 3c): rounds r01–r05 established the
+# band, and latency is lower-is-better by construction, so the newest
+# point must stay within the median-anchored multiplicative band exactly
+# like the epoch-time series (degraded/skipped rounds stay gaps).  The
+# plan-derived per-query wire-row gauge remains a zero-band counter.
+SERVE_REPORT_KEYS = ("achieved_qps",)
+SERVE_LATENCY_KEYS = ("latency_p50_ms", "latency_p99_ms")
 SERVE_COUNTER_KEYS = ("wire_rows_per_query", "wire_rows_per_exchange")
 # serve config fields that scope a serving series (a different graph size /
 # density / depth / rate / batch shape is a different measurement, not a
@@ -123,6 +134,14 @@ CONTROLLER_COUNTER_KEYS = ("exposed_wire_rows_per_step",)
 PALLAS_RAGGED_COUNTER_KEYS = ("wire_rows_per_exchange",
                               "halo_table_bytes_per_step")
 _PALLAS_RAGGED_CFG_KEYS = ("n", "graph", "k")
+# analytic per-chip HBM footprint series (ISSUE 18, the
+# memory_footprint_8dev block): every figure is derived from the CommPlan
+# + model config alone (sgcn_tpu.obs.memory — no clock, no compile, no
+# allocator anywhere), so the per-mode per-family byte counts are ZERO-band
+# counters scoped on the block's (n, nnz, k) — the mode flags live in the
+# series name.  A byte that grows at fixed config is a real residency
+# regression (a new resident array family), never noise.
+_MEMORY_CFG_KEYS = ("n", "nnz", "k")
 # scalar bench-config fields that scope a wall-clock series: a round run at
 # a different problem size / model / dtype is a DIFFERENT measurement, not
 # a regression (graph already keys separately)
@@ -245,7 +264,7 @@ def extract_series(history) -> tuple[dict, list]:
                         series[("counter", f"pallas_ragged_{arm}_{ck}")
                                + pcfg].append((rnd, float(e[ck])))
         # serving-bench series (see SERVE_* docstrings above): per transport
-        # arm, report-only latency/QPS + zero-band wire-row counters
+        # arm, report-only QPS + GATED latency + zero-band wire-row counters
         sv = parsed.get("serve_qps_8dev")
         if isinstance(sv, dict) and isinstance(sv.get("arms"), dict):
             scfg = tuple(sv.get(k) for k in _SERVE_CFG_KEYS)
@@ -257,6 +276,10 @@ def extract_series(history) -> tuple[dict, list]:
                         series[("metric", f"serve_{arm}_{rk}", "serve",
                                 rk.rsplit("_", 1)[-1]) + scfg].append(
                             (rnd, float(e[rk])))
+                for rk in SERVE_LATENCY_KEYS:
+                    if _is_num(e.get(rk)):
+                        series[("latency", f"serve_{arm}_{rk}", "serve",
+                                "ms") + scfg].append((rnd, float(e[rk])))
                 for ck in SERVE_COUNTER_KEYS:
                     if _is_num(e.get(ck)):
                         series[("counter", f"serve_{arm}_{ck}")
@@ -275,12 +298,29 @@ def extract_series(history) -> tuple[dict, list]:
                         series[("metric", f"serve_subgraph_{arm}_{rk}",
                                 "serve", rk.rsplit("_", 1)[-1])
                                + gcfg].append((rnd, float(e[rk])))
+                for rk in SERVE_LATENCY_KEYS:
+                    if _is_num(e.get(rk)):
+                        series[("latency", f"serve_subgraph_{arm}_{rk}",
+                                "serve", "ms") + gcfg].append(
+                            (rnd, float(e[rk])))
             det = sg.get("analytic")
             if isinstance(det, dict):
                 for ck in SUBGRAPH_COUNTER_KEYS:
                     if _is_num(det.get(ck)):
                         series[("counter", f"serve_subgraph_{ck}")
                                + gcfg].append((rnd, float(det[ck])))
+        # analytic per-chip HBM footprint gauges (see _MEMORY_CFG_KEYS):
+        # zero-band counters — plan-derived bytes per (mode, array family)
+        mf = parsed.get("memory_footprint_8dev")
+        if isinstance(mf, dict) and isinstance(mf.get("modes"), dict):
+            mcfg = tuple(mf.get(k) for k in _MEMORY_CFG_KEYS)
+            for mid, e in mf["modes"].items():
+                if not isinstance(e, dict):
+                    continue
+                for ck, v in sorted(e.items()):
+                    if ck.endswith("_bytes") and _is_num(v):
+                        series[("counter", f"memory_{mid}_{ck}")
+                               + mcfg].append((rnd, float(v)))
     return dict(series), gaps
 
 
@@ -299,18 +339,23 @@ def check_series(series: dict, time_band: float = DEFAULT_TIME_BAND) -> list:
         if kind == "metric":
             continue        # non-"s" units: reported, never gated (no
             #                 universal better-direction for them)
-        if kind == "time":
+        if kind in ("time", "latency"):
             # median anchor: a single lucky fast point must not tighten
             # the gate forever, and the band must clear this host's
-            # documented 1.665x cross-session drift (BASELINE.md)
+            # documented 1.665x cross-session drift (BASELINE.md).
+            # "latency" is the serve-quantile flavor (ms, lower-is-better
+            # like "s" — gated since ISSUE 18 once r01–r05 set the anchor)
             anchor = _median([v for _, v in prev])
             limit = anchor * time_band
             if last > limit:
+                what = ("a serve-latency regression"
+                        if kind == "latency"
+                        else "a measured-time regression")
                 problems.append(
                     f"{_key_name(key)}: r{last_rnd:02d} value {last:g} "
                     f"exceeds the {time_band}x band over the median "
-                    f"previous point {anchor:g} (limit {limit:g}) — a "
-                    "measured-time regression landed in the bench history")
+                    f"previous point {anchor:g} (limit {limit:g}) — "
+                    f"{what} landed in the bench history")
         else:
             if last > best:
                 problems.append(
@@ -322,7 +367,7 @@ def check_series(series: dict, time_band: float = DEFAULT_TIME_BAND) -> list:
 
 
 def _key_name(key: tuple) -> str:
-    if key[0] == "metric" and len(key) > 2 and key[2] == "serve":
+    if key[0] in ("metric", "latency") and len(key) > 2 and key[2] == "serve":
         names = (_SUBGRAPH_CFG_KEYS
                  if key[1].startswith("serve_subgraph_")
                  else _SERVE_CFG_KEYS)
@@ -341,6 +386,10 @@ def _key_name(key: tuple) -> str:
         return f"{key[1]} ({', '.join(cfg)})"
     if key[0] == "counter" and key[1].startswith("serve_"):
         cfg = [f"{k}={c}" for k, c in zip(_SERVE_CFG_KEYS, key[2:])
+               if c is not None]
+        return f"{key[1]} ({', '.join(cfg)})"
+    if key[0] == "counter" and key[1].startswith("memory_"):
+        cfg = [f"{k}={c}" for k, c in zip(_MEMORY_CFG_KEYS, key[2:])
                if c is not None]
         return f"{key[1]} ({', '.join(cfg)})"
     if key[0] == "counter" and key[1].startswith(("replica_",

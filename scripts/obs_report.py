@@ -144,6 +144,34 @@ def render(path: str, max_steps: int = 12) -> str:
                     f"{_fmt(d.get('drift_rel_max'))} {d.get('rule')} — "
                     f"sync_every {old} -> {new}")
 
+    mem = m.get("memory") if m else None
+    if mem:
+        # the per-chip HBM footprint reconciliation (schema v6,
+        # docs/observability.md): analytic model per array family, joined
+        # against XLA's memory_analysis() when a compile was measured
+        tot = mem.get("total", {})
+        lines.append(
+            "  memory (per-chip analytic model"
+            + (", measured join" if tot.get("measured_bytes") is not None
+               else "") + "):")
+        fams = sorted((mem.get("families") or {}).items(),
+                      key=lambda kv: -(kv[1].get("model_bytes") or 0))
+        for name, row in fams:
+            mb = row.get("model_bytes")
+            if not mb:
+                continue
+            lines.append(f"    {name:<16s} {mb:>12,} B")
+        for label, row in (("TOTAL", tot),
+                           ("arguments", mem.get("arguments", {})),
+                           ("donated", mem.get("donated", {}))):
+            if row.get("model_bytes") is None:
+                continue
+            joined = (f"  measured {row['measured_bytes']:,} B "
+                      f"(ratio {_fmt(row.get('ratio'), 2)})"
+                      if row.get("measured_bytes") is not None else "")
+            lines.append(f"    {label:<16s} {row['model_bytes']:>12,} B"
+                         + joined)
+
     steps = log.steps()
     if steps:
         lines.append(f"\nsteps: {len(steps)}")
@@ -259,6 +287,19 @@ def render(path: str, max_steps: int = 12) -> str:
                 f"{_fmt(r.get('exposed_comm_frac'), 3):>8} "
                 f"{_fmt(d.get('staleness_age')):>4} "
                 f"{_fmt((d.get('halo_drift_rms') or [None])[-1], 4):>10}")
+
+    # ------------------------------------------- memory reconciliation (v6)
+    mems = [e for e in log.events if e["kind"] == "memory"]
+    if mems:
+        lines.append(f"\nmemory events (per compiled program): {len(mems)}")
+        for ev in mems:
+            joined = ""
+            if ev.get("measured_peak_bytes") is not None:
+                joined = (f"  measured peak {ev['measured_peak_bytes']:,} B"
+                          f" (ratio {_fmt(ev.get('ratio'), 2)})")
+            lines.append(
+                f"  {ev.get('workload', '?')}/{ev['program']}: model "
+                f"{ev['model_bytes']:,} B" + joined)
 
     # ---------------------------------------------- measured-time layer (v2)
     spans = [e for e in log.events if e["kind"] == "span"]

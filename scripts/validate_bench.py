@@ -49,6 +49,12 @@ What counts as a violation:
     records predate the flag and retro-stamping provenance onto history
     would itself be a hand-edit); a ``measured`` flag that is present but
     not literally ``true`` is a violation at ANY round;
+  * **memory provenance** (ISSUE 18): any numeric ``*_bytes`` residency
+    claim in a bench block must sit under ``analytic: true`` (plan-derived,
+    ``sgcn_tpu.obs.memory``) or ``measured: true`` (XLA
+    ``memory_analysis()``) provenance — itself or via an enclosing block;
+    enforced from round ``BENCH_r06`` on like the measured-time rule, and
+    a present-but-untrue ``analytic`` flag is a violation at ANY round;
   * **serving-bench accounting** (PR-8): a ``serve_qps_8dev`` block must
     carry both transport arms with positive achieved QPS, ordered positive
     latency quantiles under ``measured: true`` provenance, compile counters
@@ -152,6 +158,64 @@ def check_measured_provenance(rec: dict, round_no: int | None) -> list[str]:
                     "epoch-time claim must say it was measured live "
                     "(bench.py sets the flag; rounds < "
                     f"r{MEASURED_PROVENANCE_SINCE:02d} are grandfathered)")
+    return errs
+
+
+# first bench round whose residency-byte claims must carry provenance
+# (bench.py stamps ``analytic: true`` on the memory_footprint_8dev block
+# since ISSUE 18; earlier history predates the vocabulary)
+MEMORY_PROVENANCE_SINCE = 6
+
+
+def check_memory_provenance(rec: dict, round_no: int | None) -> list[str]:
+    """The memory-provenance rule (ISSUE 18, the residency flavor of the
+    epoch-time rule above): any numeric ``*_bytes`` claim in a bench block
+    must sit in a dict that — itself or via an enclosing block — declares
+    how the number was obtained: ``analytic: true`` (derived purely from
+    the CommPlan + model config, ``sgcn_tpu.obs.memory``) or ``measured:
+    true`` (XLA's own ``compiled.memory_analysis()``).  A residency byte
+    with neither provenance is unfalsifiable.  Flag integrity — a
+    present-but-untrue ``analytic`` flag — is a violation in ANY round
+    (asserting plan-derivation falsely is a lie); the claim rule is
+    rc- and round-gated like the measured-time rule."""
+    if not isinstance(rec.get("parsed"), dict):
+        return []
+    errs: list[str] = []
+    claim_gated = (rec.get("rc") == 0
+                   and (round_no is None
+                        or round_no >= MEMORY_PROVENANCE_SINCE))
+
+    def walk(node, path: str, flagged: bool, root: bool = False) -> None:
+        if isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]", flagged)
+            return
+        if not isinstance(node, dict):
+            return
+        if "analytic" in node and node["analytic"] is not True:
+            errs.append(
+                f"{path or 'parsed'}: analytic={node['analytic']!r} — the "
+                "provenance flag may only assert a plan-derived figure "
+                "(true); drop it or fix the generator")
+        # the ROOT parsed dict's flags do not count as byte provenance:
+        # its `measured: true` asserts the headline TIME value was timed
+        # live (check_measured_provenance) — letting it inherit downward
+        # would make this rule vacuous on every bench record
+        here = (not root) and (flagged or node.get("analytic") is True
+                               or node.get("measured") is True)
+        for k, v in node.items():
+            if (claim_gated and isinstance(k, str) and k.endswith("_bytes")
+                    and _is_num(v) and not here):
+                errs.append(
+                    f"{path or 'parsed'}: numeric residency claim {k!r} "
+                    "without analytic:true or measured:true provenance in "
+                    "its block — a byte count must say whether it is "
+                    "plan-derived (sgcn_tpu.obs.memory) or from XLA "
+                    "memory_analysis() (rounds < "
+                    f"r{MEMORY_PROVENANCE_SINCE:02d} are grandfathered)")
+            walk(v, f"{path}/{k}" if path else k, here)
+
+    walk(rec["parsed"], "", False, root=True)
     return errs
 
 
@@ -947,8 +1011,8 @@ def validate_tree(root: str) -> list[str]:
         m = _BENCH_ROUND_RE.search(os.path.basename(path))
         rnd = int(m.group(1)) if m else None
         run(path, lambda rec, rnd=rnd: (check_bench_record(rec)
-                                        + check_measured_provenance(rec,
-                                                                    rnd)))
+                                        + check_measured_provenance(rec, rnd)
+                                        + check_memory_provenance(rec, rnd)))
     for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_*.json"))):
         run(path, check_multichip_record)
     for path in sorted(glob.glob(os.path.join(root, "bench_artifacts",

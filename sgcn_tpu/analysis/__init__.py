@@ -22,9 +22,11 @@ wins.  This package makes those contracts machine-checked:
   * :mod:`~sgcn_tpu.analysis.registry` — the ``CommPlan`` consumer
     contract tuples (ridden by ``tests/test_plan_contract.py``).
 
-CLI: ``python -m sgcn_tpu.analysis [--fast] [--json] [--out FILE]`` —
-emits the schema-validated JSON report (``scripts/validate_bench.py``
-checks committed copies).
+CLI: ``python -m sgcn_tpu.analysis [--fast] [--json] [--out FILE]
+[--memory]`` — emits the schema-validated JSON report
+(``scripts/validate_bench.py`` checks committed copies); ``--memory``
+adds the compiling footprint-reconciliation pass (the ``memory-model``
+rule of ``hlo_audit.run_memory_audit``).
 """
 
 from __future__ import annotations
@@ -34,8 +36,16 @@ ANALYSIS_SCHEMA_VERSION = 1
 
 
 def build_report(fast: bool = False, hlo: bool = True,
-                 ast_pass: bool = True, root: str | None = None) -> dict:
-    """Run the requested passes and assemble the analysis report."""
+                 ast_pass: bool = True, root: str | None = None,
+                 memory: bool = False) -> dict:
+    """Run the requested passes and assemble the analysis report.
+
+    ``memory`` adds the COMPILING memory-reconciliation pass
+    (``hlo_audit.run_memory_audit``): every supported mode's programs are
+    compiled and XLA's ``memory_analysis()`` joined against the analytic
+    per-chip footprint model under the ``memory-model`` rule.  Off by
+    default — it compiles (~3 min for the full matrix) where the text
+    audit only lowers."""
     report: dict = {
         "schema": ANALYSIS_SCHEMA,
         "v": ANALYSIS_SCHEMA_VERSION,
@@ -55,4 +65,9 @@ def build_report(fast: bool = False, hlo: bool = True,
         report["jax"] = jax.__version__
         report["hlo"] = run_audit(fast=fast)
         report["ok"] = report["ok"] and report["hlo"]["ok"]
+    if memory:
+        from .hlo_audit import run_memory_audit
+
+        report["memory"] = run_memory_audit(fast=fast)
+        report["ok"] = report["ok"] and report["memory"]["ok"]
     return report

@@ -33,7 +33,15 @@ def main() -> int:
                    help="skip the HLO audit (AST pass only; no jax)")
     p.add_argument("--no-ast", action="store_true",
                    help="skip the AST pass (HLO audit only)")
+    p.add_argument("--memory", action="store_true",
+                   help="also COMPILE every mode's programs and reconcile "
+                        "XLA memory_analysis() against the analytic "
+                        "footprint model (the memory-model rule; ~1 s per "
+                        "program)")
     args = p.parse_args()
+
+    if args.memory and args.no_hlo:
+        p.error("--memory needs the jax mesh; drop --no-hlo")
 
     if not args.no_hlo:
         # the audit's programs are lowered against the virtual 8-chip mesh;
@@ -47,7 +55,7 @@ def main() -> int:
     from . import build_report
 
     report = build_report(fast=args.fast, hlo=not args.no_hlo,
-                          ast_pass=not args.no_ast)
+                          ast_pass=not args.no_ast, memory=args.memory)
     if args.out:
         with open(args.out, "w") as fh:
             json.dump(report, fh, indent=1, sort_keys=True)
@@ -70,6 +78,19 @@ def _human(report: dict) -> None:
         for mode_id, entry in sorted(report["hlo"]["modes"].items()):
             print(f"hlo  {mode_id:32s} "
                   f"{'ok' if entry['ok'] else 'FAIL'}")
+            for label, prog in sorted(entry["programs"].items()):
+                for v in prog["violations"]:
+                    print(f"     - [{label}] {v['rule']}: {v['detail']}")
+    if "memory" in report:
+        for mode_id, entry in sorted(report["memory"]["modes"].items()):
+            ratios = ", ".join(
+                f"{label} {prog['ratio']:.2f}"
+                for label, prog in sorted(entry["programs"].items())
+                if prog.get("ratio") is not None)
+            print(f"mem  {mode_id:32s} "
+                  f"{'ok' if entry['ok'] else 'FAIL'}"
+                  f"  model={entry['model_bytes']:,}B"
+                  f"{'  peak/model: ' + ratios if ratios else ''}")
             for label, prog in sorted(entry["programs"].items()):
                 for v in prog["violations"]:
                     print(f"     - [{label}] {v['rule']}: {v['detail']}")

@@ -31,6 +31,15 @@ A violation names its rule (``collective-census`` / ``wire-dtype`` /
 contract) so the tier-1 mutation checks (``tests/test_analysis.py``,
 ``tests/test_pallas_ragged.py``) can prove each rule class fails on a
 seeded violation.
+
+A second, COMPILING pass (``run_memory_audit`` / ``memory_audit_mode``)
+shares the same program builders via ``lower_mode_programs`` and joins
+``compiled.memory_analysis()`` against the owner's analytic per-chip
+footprint model (``sgcn_tpu.obs.memory``) — the ``memory-model`` rule:
+measured peak within tolerance of the analytic total, argument bytes a
+subset of the modeled residency, and donation aliasing at least the
+params+opt floor (zero for serve).  Mutation-checked by seeding a
+stripped ``donate_argnums`` (``tests/test_memory_obs.py``).
 """
 
 from __future__ import annotations
@@ -369,9 +378,17 @@ def check_donation(args, exp: "expect.Expectation") -> list[dict]:
 
 
 # -------------------------------------------------------------- mode audit
-def lower_mode(mode: Mode, plan=None) -> list[tuple]:
-    """Build the real trainer/engine for ``mode`` and lower its program(s);
-    returns ``[(program_label, module_text, expectation)]``."""
+def lower_mode_programs(mode: Mode, plan=None) -> tuple:
+    """Build the real trainer/engine for ``mode`` and lower its program(s)
+    WITHOUT rendering; returns ``(owner, [(label, lowered, expectation)])``.
+
+    ``owner`` is the trainer/engine that built the programs — it carries
+    the analytic per-chip footprint model as ``.memory`` — and each
+    ``lowered`` is the un-compiled jax AOT lowering: the text audit renders
+    it (``.as_text()``), the memory audit compiles it (``.compile()``) and
+    joins ``compiled.memory_analysis()`` against ``owner.memory``.  Both
+    passes share the SAME builders so they can never audit divergent
+    programs."""
     from ..train import FullBatchTrainer
 
     plan = audit_plan() if plan is None else plan
@@ -403,10 +420,10 @@ def lower_mode(mode: Mode, plan=None) -> list[tuple]:
                     "(fwd_static keys "
                     f"{sorted(tr._fwd_static)})")
             if mode.staleness:
-                return [
-                    ("stale", tr.lower_step(kind="stale").as_text(),
+                return tr, [
+                    ("stale", tr.lower_step(kind="stale"),
                      expect.train_expectation(tr, mode, fresh=False)),
-                    ("sync", tr.lower_step(kind="sync").as_text(),
+                    ("sync", tr.lower_step(kind="sync"),
                      expect.train_expectation(tr, mode, fresh=True)),
                 ]
             if mode.replica:
@@ -414,14 +431,14 @@ def lower_mode(mode: Mode, plan=None) -> list[tuple]:
                 # step must ship the SHRUNKEN wire shapes, the refresh step
                 # the full exact exchange (with every backward exchange
                 # kept alive by the gradient-replica refresh)
-                return [
-                    ("rep", tr.lower_step(kind="rep").as_text(),
+                return tr, [
+                    ("rep", tr.lower_step(kind="rep"),
                      expect.train_expectation(tr, mode, fresh=False)),
-                    ("sync", tr.lower_step(kind="rep_sync").as_text(),
+                    ("sync", tr.lower_step(kind="rep_sync"),
                      expect.train_expectation(tr, mode, fresh=True)),
                 ]
-            return [("step", tr.lower_step().as_text(),
-                     expect.train_expectation(tr, mode))]
+            return tr, [("step", tr.lower_step(),
+                         expect.train_expectation(tr, mode))]
     if mode.workload == "minibatch":
         from ..train.minibatch import MiniBatchTrainer
 
@@ -429,15 +446,16 @@ def lower_mode(mode: Mode, plan=None) -> list[tuple]:
             raise ValueError(
                 "the minibatch audit entry builds its own per-batch plans "
                 "from the ER fixture graph; a custom plan would be "
-                "silently ignored here — extend lower_mode instead")
+                "silently ignored here — extend lower_mode_programs "
+                "instead")
         with _pallas_env(False):
             mb = MiniBatchTrainer(
                 _audit_ahat(), np.asarray(audit_plan().owner), AUDIT_K,
                 fin=AUDIT_FIN, widths=list(AUDIT_WIDTHS),
                 batch_size=AUDIT_N // 2, nbatches=2,
                 comm_schedule=mode.schedule)
-            return [("envelope-step", mb.lower_step().as_text(),
-                     expect.train_expectation(mb.inner, mode))]
+            return mb, [("envelope-step", mb.lower_step(),
+                         expect.train_expectation(mb.inner, mode))]
     if mode.workload == "serve":
         from ..serve.engine import ServeEngine
 
@@ -449,9 +467,9 @@ def lower_mode(mode: Mode, plan=None) -> list[tuple]:
                               halo_dtype=mode.halo_dtype,
                               max_batch=bucket, buckets=(bucket,),
                               precompile=False)
-            return [(f"bucket{bucket}",
-                     eng.lower_bucket(bucket).as_text(),
-                     expect.serve_expectation(eng, mode, bucket))]
+            return eng, [(f"bucket{bucket}",
+                          eng.lower_bucket(bucket),
+                          expect.serve_expectation(eng, mode, bucket))]
     if mode.workload == "serve_subgraph":
         from ..serve.engine import ServeEngine
 
@@ -465,10 +483,18 @@ def lower_mode(mode: Mode, plan=None) -> list[tuple]:
             from ..serve.subgraph import representative_key
 
             key = representative_key(eng.sgindex)
-            return [("subgraph",
-                     eng.lower_subgraph(key).as_text(),
-                     expect.serve_subgraph_expectation(eng, mode, key))]
+            return eng, [("subgraph",
+                          eng.lower_subgraph(key),
+                          expect.serve_subgraph_expectation(eng, mode, key))]
     raise ValueError(f"unknown workload {mode.workload!r}")
+
+
+def lower_mode(mode: Mode, plan=None) -> list[tuple]:
+    """Build the real trainer/engine for ``mode`` and lower its program(s);
+    returns ``[(program_label, module_text, expectation)]``."""
+    _owner, programs = lower_mode_programs(mode, plan=plan)
+    return [(label, lowered.as_text(), exp)
+            for label, lowered, exp in programs]
 
 
 @lru_cache(maxsize=1)
@@ -528,5 +554,73 @@ def run_audit(modes=None, fast: bool = False) -> dict:
             entry = audit_mode(mode, plan=banded)
             out["modes"][mode.mode_id + "@banded"] = entry
             out["ok"] = out["ok"] and entry["ok"]
+    out["n_modes"] = len(out["modes"])
+    return out
+
+
+# ------------------------------------------------------------ memory audit
+def memory_audit_mode(mode: Mode, plan=None,
+                      tol: float | None = None) -> dict:
+    """COMPILE every program of ``mode`` and reconcile XLA's own
+    ``memory_analysis()`` figures against the owner's analytic footprint
+    model (``trainer.memory`` / ``engine.memory``); returns the mode's
+    report entry.  Violations carry the ``memory-model`` rule:
+
+      * measured peak must stay within ``MEM_MODEL_TOL`` × the analytic
+        total (the model is the residency upper envelope);
+      * measured argument bytes must not exceed the modeled resident
+        arguments (jit prunes inputs, it never invents them);
+      * aliased (donated) bytes must cover the params+opt floor on train
+        programs and be exactly zero on serve programs — a stripped
+        ``donate_argnums`` trips this deterministically (the mutation
+        check of ``tests/test_memory_obs.py``).
+
+    Unlike the text audit this pass compiles (~1 s/program on the CPU
+    mesh), so callers subset the matrix: the tier-1 test pins family
+    representatives, the full sweep rides ``python -m sgcn_tpu.analysis
+    --memory``.
+    """
+    from ..obs.memory import MEM_MODEL_TOL, measure_compiled, reconcile
+
+    owner, programs = lower_mode_programs(mode, plan=plan)
+    model = owner.memory
+    entry: dict = {"ok": True, "model_bytes": model.total_bytes,
+                   "programs": {}}
+    for label, lowered, _exp in programs:
+        measured = measure_compiled(lowered.compile())
+        if measured is None:
+            # the backend exposes no memory_analysis(): the measured side
+            # is unverifiable here — surface that, don't fail (every CI
+            # backend exposes it; the analytic side still gates budgets)
+            entry["programs"][label] = {"ok": True, "skipped": True,
+                                        "violations": [], "measured": None}
+            continue
+        rec = reconcile(model, measured,
+                        tol=MEM_MODEL_TOL if tol is None else tol)
+        violations = [_viol("memory-model", v) for v in rec["violations"]]
+        entry["programs"][label] = {
+            "ok": not violations,
+            "violations": violations,
+            "measured": measured,
+            "ratio": rec["block"]["total"]["ratio"],
+        }
+        entry["ok"] = entry["ok"] and not violations
+    return entry
+
+
+def run_memory_audit(modes=None, fast: bool = False) -> dict:
+    """Memory-reconcile the mode matrix; returns the ``memory`` block of
+    the analysis report.  Same shape contract as :func:`run_audit`
+    (``{modes: {mode_id: entry}, ok, n_modes, tol}``) so the report
+    renderer and the gate logic treat both passes uniformly."""
+    from ..obs.memory import MEM_MODEL_TOL
+
+    if modes is None:
+        modes = fast_modes() if fast else supported_modes()
+    out: dict = {"modes": {}, "ok": True, "tol": MEM_MODEL_TOL}
+    for mode in modes:
+        entry = memory_audit_mode(mode)
+        out["modes"][mode.mode_id] = entry
+        out["ok"] = out["ok"] and entry["ok"]
     out["n_modes"] = len(out["modes"])
     return out

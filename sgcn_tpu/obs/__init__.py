@@ -24,6 +24,9 @@ Wired through the trainers (``FullBatchTrainer.attach_recorder`` /
 
 from .attribution import (STREAM_CEILING_GBS, StepCostModel,
                           gather_bytes_per_epoch, roofline_fields, step_cost)
+from .memory import (MEM_MODEL_TOL, MemoryBudgetError, MemoryModel,
+                     check_memory_budget, measure_compiled, memory_model,
+                     parse_bytes, reconcile)
 from .recorder import RunLog, RunRecorder, heartbeat, load_run, plan_digest
 from .schema import SCHEMA_VERSION, validate_event, validate_manifest
 from .tracing import (SpanTimer, TraceSummary, classify_op, emit_span,
@@ -31,10 +34,13 @@ from .tracing import (SpanTimer, TraceSummary, classify_op, emit_span,
                       summarize_trace, trace_path_for_run)
 
 __all__ = [
-    "SCHEMA_VERSION", "STREAM_CEILING_GBS", "RunLog", "RunRecorder",
-    "SpanTimer", "StepCostModel", "TraceSummary", "classify_op", "emit_span",
+    "MEM_MODEL_TOL", "SCHEMA_VERSION", "STREAM_CEILING_GBS",
+    "MemoryBudgetError", "MemoryModel", "RunLog", "RunRecorder",
+    "SpanTimer", "StepCostModel", "TraceSummary",
+    "check_memory_budget", "classify_op", "emit_span",
     "find_trace_files", "gather_bytes_per_epoch", "heartbeat", "load_run",
-    "measured_vs_model_block", "plan_digest", "roofline_fields",
+    "measure_compiled", "measured_vs_model_block", "memory_model",
+    "parse_bytes", "plan_digest", "reconcile", "roofline_fields",
     "scoped_span", "step_cost", "summarize_trace", "trace_path_for_run",
     "validate_event", "validate_manifest",
 ]

@@ -141,6 +141,16 @@ class RunRecorder:
         }
         self._write_manifest()
 
+    def set_memory(self, block: dict) -> None:
+        """Record the per-chip HBM footprint block (schema v6,
+        ``obs/memory.py::MemoryModel.block()``): per-family ``{model_bytes,
+        measured_bytes, ratio}`` plus the total/arguments/donated aggregate
+        joins.  Rewritten as measured joins arrive (the serve engine
+        re-publishes after each bucket compile), like every other late
+        manifest fact."""
+        self.manifest["memory"] = _jsonable(block)
+        self._write_manifest()
+
     def set_backend(self, mesh=None) -> None:
         """Record the live jax backend + mesh (call after backend init)."""
         import jax
@@ -257,6 +267,28 @@ class RunRecorder:
                        ("wall_s", wall_s)):
             if val is not None:
                 ev[k] = val
+        self._emit(ev)
+
+    def record_memory(self, program: str, model, measured: dict | None = None,
+                      budget_bytes: int | None = None) -> None:
+        """One compiled program's analytic-vs-measured HBM join (schema v6,
+        ``obs/memory.py``): ``model`` is a ``MemoryModel``, ``measured`` a
+        ``measure_compiled`` dict (``None`` when the backend exposes no
+        memory analysis — the join is then simply absent)."""
+        ev = {"kind": "memory", "program": str(program),
+              "model_bytes": int(model.total_bytes),
+              "workload": model.workload,
+              "families": {name: int(b)
+                           for name, b in model.families.items()}}
+        if measured is not None:
+            # measure_compiled's "peak_bytes" lands as "measured_peak_bytes"
+            # in the event vocabulary (the model side owns the bare names)
+            ev.update({("measured_peak_bytes" if k == "peak_bytes" else k):
+                       int(v) for k, v in measured.items()})
+            if model.total_bytes > 0:
+                ev["ratio"] = measured["peak_bytes"] / model.total_bytes
+        if budget_bytes is not None:
+            ev["budget_bytes"] = int(budget_bytes)
         self._emit(ev)
 
     def record_heartbeat(self, event: str, **fields) -> None:

@@ -54,6 +54,16 @@ Version history:
     sub-graph engine's per-query analytic gauges ride the same stream.
     Purely additive: v1–v4 streams load unchanged and must not carry the
     v5-only kind.
+  * **v6** — memory observability (``obs/memory.py``): adds the ``memory``
+    event kind (one compiled program's analytic-vs-measured per-chip HBM
+    join: the plan-derived model total against XLA's
+    ``memory_analysis()`` argument/output/temp/alias/peak bytes) and the
+    optional ``memory`` manifest block (the per-family ``{model_bytes,
+    measured_bytes, ratio}`` breakdown — ``MemoryModel.block()``).  The
+    join fields follow the ``measured_vs_model`` discipline: when both
+    endpoints are present the ``ratio`` must be derivable from them.
+    Purely additive: v1–v5 streams load unchanged and must not carry the
+    v6-only kind.
 """
 
 from __future__ import annotations
@@ -61,8 +71,8 @@ from __future__ import annotations
 import math
 import numbers
 
-SCHEMA_VERSION = 5
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5)
+SCHEMA_VERSION = 6
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6)
 
 # event stream file names inside a run directory
 MANIFEST_NAME = "manifest.json"
@@ -70,16 +80,19 @@ EVENTS_NAME = "events.jsonl"
 HEARTBEAT_NAME = "heartbeat.jsonl"
 
 EVENT_KINDS = ("step", "eval", "heartbeat", "summary", "span", "serve",
-               "checkpoint", "resume", "swap")
+               "checkpoint", "resume", "swap", "memory")
 # the span kind is a v2 addition, the serve kind v3, checkpoint/resume v4,
-# swap v5; a stream claiming an older version must not carry a newer kind
+# swap v5, memory v6; a stream claiming an older version must not carry a
+# newer kind
 _KINDS_BY_VERSION = {1: ("step", "eval", "heartbeat", "summary"),
                      2: ("step", "eval", "heartbeat", "summary", "span"),
                      3: ("step", "eval", "heartbeat", "summary", "span",
                          "serve"),
                      4: ("step", "eval", "heartbeat", "summary", "span",
                          "serve", "checkpoint", "resume"),
-                     5: EVENT_KINDS}
+                     5: ("step", "eval", "heartbeat", "summary", "span",
+                         "serve", "checkpoint", "resume", "swap"),
+                     6: EVENT_KINDS}
 
 _NUM = numbers.Real
 _STR = str
@@ -114,6 +127,11 @@ _REQUIRED = {
     # emitted AFTER provenance verification and the in-place leaf swap, so
     # every serve event after it describes the new ``weights_rev``
     "swap": {"path": _STR, "weights_rev": _NUM},
+    # v6: one compiled program's analytic-vs-measured per-chip HBM join
+    # (obs/memory.py): ``model_bytes`` is the plan-derived analytic total —
+    # always computable, like measured_vs_model's model_s; the measured
+    # side (XLA memory_analysis) rides as optional fields
+    "memory": {"program": _STR, "model_bytes": _NUM},
 }
 
 # kind -> {field: type} (optional, typed when present)
@@ -187,6 +205,19 @@ _OPTIONAL = {
         "checkpoint_step": _NUM,  # the swapped checkpoint's training step
         "wall_s": _NUM,           # load+verify+swap duration (host clock)
     },
+    "memory": {
+        "workload": _STR,             # 'train' | 'serve' | 'serve_subgraph'
+        "measured_peak_bytes": _NUM,  # arg + out + temp − alias (per device)
+        "argument_bytes": _NUM,       # XLA memory_analysis components
+        "output_bytes": _NUM,
+        "temp_bytes": _NUM,
+        "alias_bytes": _NUM,          # donated set (0 for serve programs)
+        "generated_code_bytes": _NUM,
+        "ratio": _NUM,                # measured_peak / model — must be
+        #                               derivable from its own record
+        "families": dict,             # per-family model_bytes detail
+        "budget_bytes": _NUM,         # the --memory-budget in force, if any
+    },
 }
 
 # comm snapshot: the CommStats.report() keys every step event must reconcile
@@ -253,7 +284,19 @@ _MANIFEST_OPTIONAL = {
     # their gzip'd sizes — obs_report.py parses the trace from the run
     # directory alone (obs/tracing.py::find_trace_files)
     "profile": dict,
+    # v6: the per-chip HBM footprint block (obs/memory.py::MemoryModel
+    # .block()): per-family {model_bytes, measured_bytes, ratio} plus the
+    # total/arguments/donated aggregate joins — validated below so a
+    # manifest's memory claims are self-consistent
+    "memory": dict,
 }
+
+# memory-join entries ({model_bytes, measured_bytes, ratio} — the manifest
+# memory block's per-family rows and the aggregate rows): model_bytes is
+# required and non-negative; measured_bytes may be None (no compiled
+# program measured yet); when both endpoints are present and model > 0 the
+# ratio must be derivable from them (same rule as measured_vs_model).
+_MEMORY_AGGREGATES = ("total", "arguments", "donated")
 
 # measured_vs_model component entries: required/optional numeric fields.
 # ``model_s`` is the analytic prediction, ``measured_s`` the span- or
@@ -325,6 +368,50 @@ def _validate_measured_vs_model(mvm: dict) -> None:
                         "from its own record")
 
 
+def _validate_memory_join(entry, what: str) -> None:
+    if not isinstance(entry, dict):
+        raise ValueError(f"{what}: memory join entry must be a dict, got "
+                         f"{type(entry).__name__}")
+    mb = entry.get("model_bytes")
+    if not (isinstance(mb, _NUM) and not isinstance(mb, bool)
+            and math.isfinite(mb) and mb >= 0):
+        raise ValueError(
+            f"{what}: model_bytes={mb!r} (the analytic side must always "
+            "be a non-negative byte count)")
+    meas = entry.get("measured_bytes")
+    if meas is None:
+        return
+    if not (isinstance(meas, _NUM) and not isinstance(meas, bool)
+            and math.isfinite(meas) and meas >= 0):
+        raise ValueError(f"{what}: measured_bytes={meas!r}")
+    if mb > 0:
+        want = meas / mb
+        got = entry.get("ratio")
+        if not (isinstance(got, _NUM) and not isinstance(got, bool)
+                and math.isfinite(got)
+                and abs(got - want) <= _MVM_REL_TOL * max(abs(want), 1.0)):
+            raise ValueError(
+                f"{what}: ratio={got!r} inconsistent with measured/model "
+                f"endpoints (expected {want!r}) — the join must be "
+                "derivable from its own record")
+
+
+def _validate_memory_block(mem: dict) -> None:
+    fams = mem.get("families")
+    if not isinstance(fams, dict) or not fams:
+        raise ValueError(
+            "manifest memory block: missing/empty families dict — the "
+            "itemized per-family breakdown IS the block")
+    for name, entry in fams.items():
+        _validate_memory_join(entry, f"memory family {name!r}")
+    for agg in _MEMORY_AGGREGATES:
+        if agg not in mem:
+            raise ValueError(
+                f"manifest memory block missing the {agg!r} aggregate "
+                f"join (must carry all of {_MEMORY_AGGREGATES})")
+        _validate_memory_join(mem[agg], f"memory aggregate {agg!r}")
+
+
 def validate_event(ev: dict) -> None:
     """Raise ``ValueError`` unless ``ev`` is a valid event under its own
     declared schema version (``SUPPORTED_VERSIONS`` — v1 streams written
@@ -393,6 +480,26 @@ def validate_event(ev: dict) -> None:
             raise ValueError(
                 f"serve event: serve_mode={ev['serve_mode']!r} not "
                 "'full'/'subgraph'")
+    if kind == "memory":
+        for f in ("model_bytes", "measured_peak_bytes", "argument_bytes",
+                  "output_bytes", "temp_bytes", "alias_bytes",
+                  "generated_code_bytes", "ratio", "budget_bytes"):
+            if f in ev and isinstance(ev[f], _NUM) and (
+                    not math.isfinite(ev[f]) or ev[f] < 0):
+                raise ValueError(
+                    f"memory event: non-finite/negative {f}={ev[f]}")
+        if "workload" in ev and ev["workload"] not in (
+                "train", "serve", "serve_subgraph"):
+            raise ValueError(
+                f"memory event: workload={ev['workload']!r} not "
+                "'train'/'serve'/'serve_subgraph'")
+        if "ratio" in ev and isinstance(ev.get("measured_peak_bytes"), _NUM) \
+                and ev["model_bytes"] > 0:
+            want = ev["measured_peak_bytes"] / ev["model_bytes"]
+            if abs(ev["ratio"] - want) > _MVM_REL_TOL * max(abs(want), 1.0):
+                raise ValueError(
+                    f"memory event: ratio={ev['ratio']!r} inconsistent "
+                    f"with measured/model endpoints (expected {want!r})")
     if kind == "step" and isinstance(ev.get("measured_vs_model"), dict):
         _validate_measured_vs_model(ev["measured_vs_model"])
     if kind == "step" and "comm" in ev and ev["comm"] is not None:
@@ -500,6 +607,8 @@ def validate_manifest(m: dict) -> None:
             f"manifest schema version {m.get('v')!r} not in "
             f"{SUPPORTED_VERSIONS}")
     _check_fields(m, _MANIFEST_REQUIRED, _MANIFEST_OPTIONAL, "manifest")
+    if isinstance(m.get("memory"), dict):
+        _validate_memory_block(m["memory"])
     prof = m.get("profile")
     if isinstance(prof, dict):
         if not isinstance(prof.get("dir"), str):

@@ -25,6 +25,17 @@ import json
 import sys
 
 
+def _mem_budget(text: str) -> int:
+    """``--memory-budget`` values: bytes with optional binary suffix
+    (``512M``, ``2G``; ``obs/memory.py::parse_bytes``)."""
+    from ..obs.memory import parse_bytes
+
+    try:
+        return parse_bytes(text)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e)) from e
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description="sgcn_tpu partitioned inference")
     p.add_argument("-a", "--adjacency", default=None,
@@ -105,6 +116,13 @@ def main() -> None:
                    help="run-telemetry directory (sgcn_tpu.obs): manifest + "
                         "serve/span events; render with "
                         "scripts/obs_report.py")
+    p.add_argument("--memory-budget", type=_mem_budget, default=None,
+                   metavar="BYTES",
+                   help="per-chip HBM budget (suffixes K/M/G/T, e.g. 2G): "
+                        "the analytic footprint model "
+                        "(sgcn_tpu.obs.memory) is checked before any "
+                        "bucket compiles; over budget fails with the "
+                        "itemized per-family breakdown")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -188,18 +206,22 @@ def main() -> None:
                if args.buckets else None)
 
     from ..obs import RunRecorder
+    from ..obs.memory import MemoryBudgetError
     from .engine import ServeEngine
     from .loadgen import run_loadgen, synthetic_query_ids
 
-    engine = ServeEngine(
-        plan, fin=f, widths=widths, model=model,
-        activation=activation,
-        final_activation=final_activation or "none",
-        comm_schedule=args.comm_schedule, halo_dtype=args.halo_dtype,
-        checkpoint=args.checkpoint, max_batch=args.max_batch,
-        buckets=buckets, latency_budget_ms=args.latency_budget_ms,
-        shed_factor=args.shed_factor, seed=args.seed,
-        mode=args.serve_mode)
+    try:
+        engine = ServeEngine(
+            plan, fin=f, widths=widths, model=model,
+            activation=activation,
+            final_activation=final_activation or "none",
+            comm_schedule=args.comm_schedule, halo_dtype=args.halo_dtype,
+            checkpoint=args.checkpoint, max_batch=args.max_batch,
+            buckets=buckets, latency_budget_ms=args.latency_budget_ms,
+            shed_factor=args.shed_factor, seed=args.seed,
+            mode=args.serve_mode, memory_budget=args.memory_budget)
+    except MemoryBudgetError as e:
+        raise SystemExit(str(e)) from e
     engine.set_features(feats)
     if args.watch_checkpoint_dir:
         engine.attach_checkpoint_watch(args.watch_checkpoint_dir)

@@ -136,6 +136,7 @@ class ServeEngine:
         seed: int = 0,
         precompile: bool = True,
         mode: str = "full",
+        memory_budget: int | None = None,
     ):
         """``mode='full'`` is the PR-8 engine: one full partitioned forward
         per micro-batch.  ``mode='subgraph'`` is query-proportional
@@ -170,6 +171,18 @@ class ServeEngine:
             serve_subgraph=(mode == "subgraph"))
         self.comm_schedule = self.setup.comm_schedule
         self.comm_decision = self.setup.decision
+        # analytic per-chip HBM footprint (obs/memory.py) + the
+        # --memory-budget plan-time gate — before params/array shipping,
+        # failing loudly with the itemized per-family table
+        from ..obs.memory import check_memory_budget, memory_model
+        self.memory = memory_model(
+            plan, fin, widths,
+            workload="serve_subgraph" if mode == "subgraph" else "serve",
+            model=model, halo_dtype=halo_dtype, setup=self.setup)
+        self._memory_measured = None       # best measured join so far (the
+        # widest compiled bucket's memory_analysis — _ensure_compiled)
+        check_memory_budget(self.memory, memory_budget,
+                            what=f"{model} serve engine ({mode})")
         self.mesh = mesh if mesh is not None else make_mesh_1d(plan.k)
         self.router = VertexRouter(plan)
         self.batcher = MicroBatcher(
@@ -376,7 +389,30 @@ class ServeEngine:
         if q not in self._compiled:
             self._compiled[q] = self.lower_bucket(q).compile()
             self.compile_count += 1
+            self._join_memory(f"bucket{q}", self._compiled[q])
         return self._compiled[q]
+
+    def _join_memory(self, program: str, compiled) -> None:
+        """Join XLA's measured per-device figures against the analytic
+        footprint for one freshly compiled program (schema v6): keeps the
+        peak-heaviest join as the engine's measured side and, under a
+        recorder, re-publishes the manifest memory block and appends one
+        ``memory`` event — the serving half of the model-vs-measured
+        memory contract (docs/observability.md)."""
+        from ..obs.memory import measure_compiled
+
+        measured = measure_compiled(compiled)
+        if measured is None:
+            return
+        if (self._memory_measured is None
+                or measured["peak_bytes"]
+                > self._memory_measured["peak_bytes"]):
+            self._memory_measured = measured
+        if self.recorder is not None:
+            self.recorder.set_memory(
+                self.memory.block(self._memory_measured))
+            self.recorder.record_memory(
+                program=program, model=self.memory, measured=measured)
 
     def lower_subgraph(self, key: tuple):
         """AOT-LOWER the sub-graph program for one shape key (no compile,
@@ -442,6 +478,7 @@ class ServeEngine:
         if key not in self._sg_compiled:
             self._sg_compiled[key] = self.lower_subgraph(key).compile()
             self.compile_count += 1
+            self._join_memory(f"subgraph{key[1]}", self._sg_compiled[key])
         return self._sg_compiled[key]
 
     # --------------------------------------------------------------- query
@@ -598,11 +635,22 @@ class ServeEngine:
         ``nlayers · wire_rows/exchange ÷ max_batch``."""
         from ..obs.attribution import forward_flops
 
+        # plan-derived per-chip residency (obs/memory.py) — `analytic: true`
+        # is the provenance flag scripts/validate_bench.py requires on any
+        # *_bytes residency claim in a bench block
+        mem = {"analytic": True,
+               "model_bytes": self.memory.total_bytes,
+               **{f"{name}_bytes": int(v)
+                  for name, v in self.memory.families.items() if v}}
+        if self._memory_measured is not None:
+            mem["measured"] = True
+            mem["measured_peak_bytes"] = self._memory_measured["peak_bytes"]
         if self.mode == "subgraph":
             t = self._sg_totals
             nq = max(t["queries"], 1)
             return {
                 "serve_mode": "subgraph",
+                "memory": mem,
                 "comm_schedule": self.comm_schedule,
                 "weights_rev": self.weights_rev,
                 # prefixed: these are ENGINE-LIFETIME accumulators (warmup
@@ -630,6 +678,7 @@ class ServeEngine:
         true = int(self.plan.predicted_send_volume.sum())
         return {
             "serve_mode": "full",
+            "memory": mem,
             "comm_schedule": self.comm_schedule,
             "weights_rev": self.weights_rev,
             "exchanges_per_batch": self.nlayers,
@@ -654,6 +703,10 @@ class ServeEngine:
         self.spans.recorder = recorder
         if self.comm_decision:
             recorder.set_comm_schedule(self.comm_decision)
+        if getattr(self, "memory", None) is not None:
+            # includes the measured join when a bucket already compiled
+            # (precompile=True attaches after __init__)
+            recorder.set_memory(self.memory.block(self._memory_measured))
 
     def record_window(self, result, offered_qps: float | None = None,
                       mode: str = "open") -> None:

@@ -35,6 +35,17 @@ def _budget(text: str):
     return int(text)
 
 
+def _mem_budget(text: str) -> int:
+    """``--memory-budget`` values: bytes with optional binary suffix
+    (``512M``, ``2G``; ``obs/memory.py::parse_bytes``)."""
+    from ..obs.memory import parse_bytes
+
+    try:
+        return parse_bytes(text)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e)) from e
+
+
 def _resume_auto(mgr, target, recorder):
     """The ONE --resume auto sequence for both trainers: restore the
     newest intact checkpoint into ``target``, surface the partial-state
@@ -232,6 +243,14 @@ def main() -> None:
                         "attribution and (stale mode) drift gauges; render "
                         "with scripts/obs_report.py, schema in "
                         "docs/observability.md")
+    p.add_argument("--memory-budget", type=_mem_budget, default=None,
+                   metavar="BYTES",
+                   help="per-chip HBM budget (suffixes K/M/G/T, e.g. 2G): "
+                        "the analytic footprint model "
+                        "(sgcn_tpu.obs.memory) is checked at PLAN time — "
+                        "before any array ships or compile starts — and an "
+                        "over-budget (plan, mode) fails with the itemized "
+                        "per-family breakdown")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -451,14 +470,20 @@ def main() -> None:
                                 keep_last=args.keep_checkpoints)
     resumed = None
 
+    from ..obs.memory import MemoryBudgetError
+
     with prof:
         if args.batch_size is not None:
-            tr = MiniBatchTrainer(a, pv, k, fin=f, widths=widths,
-                                  batch_size=args.batch_size, lr=args.lr,
-                                  model=args.model, loss=args.loss,
-                                  activation=activation, seed=args.seed,
-                                  compute_dtype=args.dtype,
-                                  comm_schedule=args.comm_schedule)
+            try:
+                tr = MiniBatchTrainer(a, pv, k, fin=f, widths=widths,
+                                      batch_size=args.batch_size, lr=args.lr,
+                                      model=args.model, loss=args.loss,
+                                      activation=activation, seed=args.seed,
+                                      compute_dtype=args.dtype,
+                                      comm_schedule=args.comm_schedule,
+                                      memory_budget=args.memory_budget)
+            except MemoryBudgetError as e:
+                raise SystemExit(str(e)) from e
             if recorder is not None:
                 recorder.set_partitioner({"partvec": args.partvec, "k": k})
                 tr.attach_recorder(recorder)
@@ -479,17 +504,21 @@ def main() -> None:
                                 warmup=args.warmup)
         else:
             plan = build_comm_plan(a, pv, k)
-            tr = FullBatchTrainer(plan, fin=f, widths=widths, lr=args.lr,
-                                  model=args.model, loss=args.loss,
-                                  activation=activation, seed=args.seed,
-                                  compute_dtype=args.dtype,
-                                  halo_dtype=args.halo_dtype,
-                                  halo_staleness=args.halo_staleness,
-                                  halo_delta=args.halo_delta,
-                                  sync_every=args.sync_every,
-                                  comm_schedule=args.comm_schedule,
-                                  replica_budget=args.replica_budget,
-                                  refresh_band=args.refresh_band)
+            try:
+                tr = FullBatchTrainer(plan, fin=f, widths=widths, lr=args.lr,
+                                      model=args.model, loss=args.loss,
+                                      activation=activation, seed=args.seed,
+                                      compute_dtype=args.dtype,
+                                      halo_dtype=args.halo_dtype,
+                                      halo_staleness=args.halo_staleness,
+                                      halo_delta=args.halo_delta,
+                                      sync_every=args.sync_every,
+                                      comm_schedule=args.comm_schedule,
+                                      replica_budget=args.replica_budget,
+                                      refresh_band=args.refresh_band,
+                                      memory_budget=args.memory_budget)
+            except MemoryBudgetError as e:
+                raise SystemExit(str(e)) from e
             if recorder is not None:
                 recorder.set_plan(plan, partitioner={"partvec": args.partvec,
                                                      "k": k})

@@ -418,6 +418,7 @@ class FullBatchTrainer:
         refresh_band: float | None = None,
         auto_tune_sync: bool = False,
         allow_pallas: bool = True,
+        memory_budget: int | None = None,
     ):
         """``compute_dtype='bfloat16'`` runs forward/backward (including the
         halo exchange — half the ICI bytes) in bf16 with f32 master params
@@ -617,6 +618,18 @@ class FullBatchTrainer:
         self.plan = plan
         self.fin = fin
         self.widths = list(widths)
+        # analytic per-chip HBM footprint (obs/memory.py) + the
+        # --memory-budget plan-time gate: an over-budget (plan, mode) fails
+        # HERE — before any params init or array shipping — with the
+        # itemized per-family table (docs/observability.md, memory block)
+        from ..obs.memory import check_memory_budget, memory_model
+        self.memory = memory_model(
+            plan, fin, self.widths, workload="train", model=model,
+            compute_dtype=compute_dtype, halo_dtype=halo_dtype,
+            halo_staleness=halo_staleness, halo_delta=halo_delta,
+            refresh_band=refresh_band, setup=setup)
+        check_memory_budget(self.memory, memory_budget,
+                            what=f"{model} trainer")
         # run telemetry (sgcn_tpu.obs): attach_recorder() compiles the
         # telemetry step variants; until then the recorder is off and every
         # code path below is the pre-existing trainer
@@ -1580,6 +1593,11 @@ class FullBatchTrainer:
             # the run manifest, so an 'auto' pick is reconstructible from
             # the run directory alone (docs/observability.md)
             recorder.set_comm_schedule(self.comm_decision)
+        if getattr(self, "memory", None) is not None:
+            # the analytic footprint (model-only here — the measured join
+            # needs a compiled program; the audit and the serve engine add
+            # it) lands in the manifest's schema-v6 memory block
+            recorder.set_memory(self.memory.block())
         self._ensure_tel_programs()
 
     def _step_cost_model(self, sync_step: bool = True):

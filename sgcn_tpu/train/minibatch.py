@@ -86,6 +86,7 @@ class MiniBatchTrainer:
         compute_dtype: str | None = None,
         comm_schedule: str | None = None,
         replica_budget: int = 0,
+        memory_budget: int | None = None,
     ):
         if replica_budget:
             # the replica carries cache per-layer activations of ONE plan's
@@ -177,7 +178,11 @@ class MiniBatchTrainer:
             activation=activation, model=model, loss=loss,
             optimizer=optimizer, seed=seed,
             compute_dtype=compute_dtype, comm_schedule=comm_schedule,
-            allow_pallas=False)
+            allow_pallas=False, memory_budget=memory_budget)
+        # the inner trainer's plan IS the shared envelope every batch pads
+        # to, so its analytic footprint (obs/memory.py) covers every batch's
+        # step — the --memory-budget gate above already held it to account
+        self.memory = self.inner.memory
         # checkpoints save through `inner`, whose plan is a padded per-BATCH
         # plan — its digest varies with batch_size/nbatches/pad envelope, so
         # it is not a stable run identity; suppress it (utils/checkpoint.py
@@ -203,6 +208,8 @@ class MiniBatchTrainer:
         self.inner.spans.recorder = recorder
         if getattr(self, "comm_decision", None):
             recorder.set_comm_schedule(self.comm_decision)
+        if getattr(self, "memory", None) is not None:
+            recorder.set_memory(self.memory.block())
 
     def _comm_snapshot(self, stats: CommStats) -> dict:
         """O(k) running equivalent of ``CommStats.merged_report`` over every

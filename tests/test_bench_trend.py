@@ -288,46 +288,106 @@ def test_bench_emits_the_measured_flag():
     assert src.count('"measured": True') >= 2
 
 
+def _serve_rec(p50, wire_q, nnz=160000):
+    arms = {"a2a": {"achieved_qps": 40.0, "latency_p50_ms": p50,
+                    "latency_p99_ms": p50 * 3,
+                    "wire_rows_per_exchange": 1000,
+                    "wire_rows_per_query": 187.5},
+            "ragged": {"achieved_qps": 42.0, "latency_p50_ms": p50,
+                       "latency_p99_ms": p50 * 3,
+                       "wire_rows_per_exchange": 600,
+                       "wire_rows_per_query": wire_q}}
+    return _rec(0.1, serve_qps_8dev={
+        "n": 20000, "graph": "ba", "nnz": nnz, "nlayers": 2, "k": 8,
+        "offered_qps": 50.0, "max_batch": 16, "measured": True,
+        "arms": arms})
+
+
 def test_serve_series_registration(tmp_path):
-    """The PR-8 serving series: measured latency/QPS register REPORT-ONLY
-    (non-"s" units — never banded), the plan-derived wire-row gauges as
-    zero-band counters scoped to the serve config; a wire-row increase
-    within one config trips the gate, a latency increase does not."""
+    """The serving series after ISSUE 18: measured QPS stays REPORT-ONLY
+    (no universal better-direction once arms saturate differently), the
+    latency quantiles register under the GATED "latency" kind, and the
+    plan-derived wire-row gauges stay zero-band counters scoped to the
+    serve config; a wire-row increase within one config trips the gate."""
     from bench_trend import _SERVE_CFG_KEYS
 
-    def serve_rec(p50, wire_q, nnz=160000):
-        arms = {"a2a": {"achieved_qps": 40.0, "latency_p50_ms": p50,
-                        "latency_p99_ms": p50 * 3,
-                        "wire_rows_per_exchange": 1000,
-                        "wire_rows_per_query": 187.5},
-                "ragged": {"achieved_qps": 42.0, "latency_p50_ms": p50,
-                           "latency_p99_ms": p50 * 3,
-                           "wire_rows_per_exchange": 600,
-                           "wire_rows_per_query": wire_q}}
-        return _rec(0.1, serve_qps_8dev={
-            "n": 20000, "graph": "ba", "nnz": nnz, "nlayers": 2, "k": 8,
-            "offered_qps": 50.0, "max_batch": 16, "measured": True,
-            "arms": arms})
-
     root = _write_history(tmp_path, [
-        (1, serve_rec(4.0, 112.5)), (2, serve_rec(9.0, 112.5)),
+        (1, _serve_rec(4.0, 112.5)), (2, _serve_rec(5.0, 112.5)),
     ])
-    block = serve_rec(0, 0)["parsed"]["serve_qps_8dev"]
+    block = _serve_rec(0, 0)["parsed"]["serve_qps_8dev"]
     cfg = tuple(block[k] for k in _SERVE_CFG_KEYS)
     series, _ = extract_series(load_history(root))
-    lat_key = ("metric", "serve_ragged_latency_p50_ms", "serve", "ms") + cfg
-    assert [v for _, v in series[lat_key]] == [4.0, 9.0]
+    lat_key = ("latency", "serve_ragged_latency_p50_ms", "serve", "ms") + cfg
+    assert [v for _, v in series[lat_key]] == [4.0, 5.0]
+    qps_key = ("metric", "serve_ragged_achieved_qps", "serve", "qps") + cfg
+    assert qps_key in series            # QPS: still report-only
     ctr_key = ("counter", "serve_ragged_wire_rows_per_query") + cfg
     assert [v for _, v in series[ctr_key]] == [112.5, 112.5]
-    assert not check_series(series)     # latency doubled: report-only
+    assert not check_series(series)     # +25% p50: inside the 2x band
     # a denser graph (different nnz) is a NEW series, not a regression
     with open(os.path.join(root, "BENCH_r03.json"), "w") as fh:
-        json.dump(serve_rec(4.0, 300.0, nnz=640000), fh)
+        json.dump(_serve_rec(4.0, 300.0, nnz=640000), fh)
     series, _ = extract_series(load_history(root))
     assert not check_series(series)
     # but a wire-row regression within ONE config DOES trip the zero band
     with open(os.path.join(root, "BENCH_r04.json"), "w") as fh:
-        json.dump(serve_rec(4.0, 150.0), fh)
+        json.dump(_serve_rec(4.0, 150.0), fh)
     series, _ = extract_series(load_history(root))
     problems = check_series(series)
     assert any("serve_ragged_wire_rows_per_query" in p for p in problems)
+
+
+def test_serve_latency_gate_trips_on_regression(tmp_path):
+    """ISSUE 18 satellite: serve latency is no longer report-only — a
+    quantile beyond the 2x median-anchored band fails --check with the
+    serve-latency message (the same synthetic-regressed-artifact shape the
+    wall-clock gate is pinned with)."""
+    root = _write_history(tmp_path, [
+        (1, _serve_rec(4.0, 112.5)), (2, _serve_rec(5.0, 112.5)),
+        (3, _serve_rec(4.5, 112.5)),
+        (4, _serve_rec(4.5 * DEFAULT_TIME_BAND * 2, 112.5)),
+    ])
+    problems = check_series(extract_series(load_history(root))[0])
+    lat_hits = [p for p in problems if "latency" in p]
+    assert lat_hits, problems
+    assert any("serve-latency regression" in p for p in lat_hits)
+    # both quantiles of both arms regressed in the synthetic record
+    assert any("serve_ragged_latency_p99_ms" in p for p in lat_hits)
+
+
+def test_memory_footprint_counters_zero_band(tmp_path):
+    """ISSUE 18 satellite: the analytic per-chip footprint gauges register
+    as zero-band counters scoped by (n, nnz, k) — a byte of growth in any
+    family within one config trips the gate; a different graph size is a
+    new series."""
+    from bench_trend import _MEMORY_CFG_KEYS
+
+    def mem_rec(ws, nnz=160000):
+        return _rec(0.1, memory_footprint_8dev={
+            "n": 20000, "nnz": nnz, "k": 8, "graph": "ba", "fin": 32,
+            "nlayers": 2, "analytic": True, "modes": {
+                "train_gcn_a2a": {"analytic": True, "model_bytes": 1000 + ws,
+                                  "params_bytes": 400,
+                                  "workspace_bytes": ws},
+            }})
+
+    root = _write_history(tmp_path, [(1, mem_rec(600)), (2, mem_rec(600))])
+    series, _ = extract_series(load_history(root))
+    cfg = tuple(mem_rec(0)["parsed"]["memory_footprint_8dev"][k]
+                for k in _MEMORY_CFG_KEYS)
+    key = ("counter", "memory_train_gcn_a2a_workspace_bytes") + cfg
+    assert [v for _, v in series[key]] == [600.0, 600.0]
+    assert ("counter", "memory_train_gcn_a2a_model_bytes") + cfg in series
+    assert not check_series(series)
+    # a different nnz scopes a fresh series — no cross-config comparison
+    with open(os.path.join(root, "BENCH_r03.json"), "w") as fh:
+        json.dump(mem_rec(9000, nnz=640000), fh)
+    series, _ = extract_series(load_history(root))
+    assert not check_series(series)
+    # one byte of growth within the SAME config is a regression
+    with open(os.path.join(root, "BENCH_r04.json"), "w") as fh:
+        json.dump(mem_rec(601), fh)
+    problems = check_series(extract_series(load_history(root))[0])
+    assert any("memory_train_gcn_a2a_workspace_bytes" in p
+               for p in problems), problems
+    assert any("may never regress" in p for p in problems)

@@ -232,3 +232,26 @@ def test_package_dispatcher_rejects_arguments():
     r = run_cli(["sgcn_tpu", "train", "-a", "x.mtx"])
     assert r.returncode == 2
     assert "sgcn_tpu.train" in r.stderr      # points at the real module
+
+
+def test_train_cli_memory_budget_gate(pipeline):
+    """ISSUE 18 acceptance shape: an over-budget (plan, mode) is rejected
+    AT PLAN TIME — nonzero exit, the itemized per-family breakdown on
+    stderr, no traceback (a clean SystemExit, not an OOM mid-compile);
+    a generous budget trains normally."""
+    d = pipeline
+    base = ["sgcn_tpu.train", "-a", str(d / "g.A.mtx"),
+            "-p", str(d / "g.A.mtx.4.hp"), "-b", "cpu", "-s", "4",
+            "-l", "2", "-f", "6", "--epochs", "1"]
+    r = run_cli([*base, "--memory-budget", "1K"])
+    assert r.returncode == 1, r.stdout
+    assert "exceeds --memory-budget 1,024 B" in r.stderr
+    assert "per-family breakdown" in r.stderr
+    assert "params" in r.stderr and "TOTAL" in r.stderr
+    assert "Traceback" not in r.stderr
+    r = run_cli([*base, "--memory-budget", "1G"])
+    assert r.returncode == 0, r.stderr
+    # a malformed size is an argparse error (exit 2), naming the flag
+    r = run_cli([*base, "--memory-budget", "lots"])
+    assert r.returncode == 2
+    assert "--memory-budget" in r.stderr

@@ -458,3 +458,58 @@ def test_v2_span_event_validates():
     with pytest.raises(ValueError, match="non-finite"):
         validate_event({"v": 2, "ts": 1.0, "kind": "span", "name": "x",
                         "dur_s": float("nan")})
+
+
+def test_v5_fixture_run_loads_clean():
+    """The frozen v5 run dir (pre-memory: no ``memory`` event kind, no
+    manifest ``memory`` block) must load through the CURRENT loader without
+    modification — the one-release back-compat contract, re-pinned at the
+    v5 -> v6 bump (ISSUE 18)."""
+    from sgcn_tpu.obs import load_run, validate_event
+
+    log = load_run(os.path.join(FIX, "v5_run"))
+    assert log.manifest["v"] == 5
+    assert "memory" not in log.manifest
+    assert [e["kind"] for e in log.events] == [
+        "span", "step", "span", "span", "step", "span", "span", "step",
+        "span", "summary", "summary"]
+    assert len(log.heartbeats) == 2
+    assert all(e["v"] == 5 for e in log.events + log.heartbeats)
+    for ev in log.events + log.heartbeats:
+        validate_event(ev)
+
+
+def test_v5_stream_may_not_carry_v6_kinds():
+    from sgcn_tpu.obs import validate_event
+
+    with pytest.raises(ValueError, match="kind"):
+        validate_event({"v": 5, "ts": 1.0, "kind": "memory",
+                        "program": "train_step", "model_bytes": 1024})
+
+
+def test_v6_memory_event_validates():
+    from sgcn_tpu.obs import validate_event
+
+    # model-only (plan-time) and with the XLA measured join + ratio
+    validate_event({"v": 6, "ts": 1.0, "kind": "memory",
+                    "program": "train_step", "workload": "train",
+                    "model_bytes": 2048})
+    validate_event({"v": 6, "ts": 1.0, "kind": "memory",
+                    "program": "bucket0", "workload": "serve",
+                    "model_bytes": 2048, "measured_peak_bytes": 1024,
+                    "argument_bytes": 512, "output_bytes": 256,
+                    "temp_bytes": 256, "alias_bytes": 0,
+                    "generated_code_bytes": 4096, "ratio": 0.5,
+                    "budget_bytes": 1 << 30})
+    with pytest.raises(ValueError, match="workload"):
+        validate_event({"v": 6, "ts": 1.0, "kind": "memory",
+                        "program": "x", "workload": "infer",
+                        "model_bytes": 1})
+    with pytest.raises(ValueError, match="non-finite/negative"):
+        validate_event({"v": 6, "ts": 1.0, "kind": "memory",
+                        "program": "x", "model_bytes": -1})
+    # the ratio must agree with its own endpoints
+    with pytest.raises(ValueError, match="ratio"):
+        validate_event({"v": 6, "ts": 1.0, "kind": "memory",
+                        "program": "x", "model_bytes": 1000,
+                        "measured_peak_bytes": 500, "ratio": 2.0})

@@ -588,3 +588,57 @@ def test_validator_rejects_hand_flipped_top_level_ok():
         "ok": False, "violations": ["x"]}
     assert any("green in every rule" in e
                for e in check_analysis_report(rec))
+
+
+def test_memory_provenance_rule():
+    """ISSUE 18: a numeric ``*_bytes`` claim anywhere in a bench block
+    needs ``analytic: true`` or ``measured: true`` provenance — its own
+    dict's or inherited from an enclosing block; the flag-integrity half
+    (a present-but-untrue ``analytic``) fires in ANY round."""
+    from validate_bench import (MEMORY_PROVENANCE_SINCE,
+                                check_memory_provenance)
+
+    def rec(block, rc=0):
+        return {"n": 1, "cmd": "x", "rc": rc, "tail": "",
+                "parsed": {"metric": "m", "value": 0.1, "unit": "s",
+                           "measured": True, "memory_footprint_8dev": block}}
+
+    naked = rec({"modes": {"train_gcn_a2a": {"model_bytes": 1000}}})
+    errs = check_memory_provenance(naked, MEMORY_PROVENANCE_SINCE)
+    assert any("model_bytes" in e and "provenance" in e for e in errs)
+    # rounds before the gate (and failed rounds) are grandfathered
+    assert not check_memory_provenance(
+        naked, MEMORY_PROVENANCE_SINCE - 1)
+    assert not check_memory_provenance(
+        rec({"modes": {"m": {"model_bytes": 1}}}, rc=1),
+        MEMORY_PROVENANCE_SINCE)
+    # the flag on the claiming dict itself satisfies the rule...
+    assert not check_memory_provenance(
+        rec({"modes": {"m": {"analytic": True, "model_bytes": 1}}}), 9)
+    # ...and so does an ANCESTOR block's flag (bench.py stamps both)
+    assert not check_memory_provenance(
+        rec({"analytic": True,
+             "modes": {"m": {"model_bytes": 1, "params_bytes": 2}}}), 9)
+    # measured: true (XLA memory_analysis) is the other accepted provenance
+    assert not check_memory_provenance(
+        rec({"modes": {"m": {"measured": True, "peak_bytes": 1}}}), 9)
+    # a present-but-untrue analytic flag lies about plan-derivation —
+    # violation at ANY round, even grandfathered/failed ones
+    for lying_round, rc in ((1, 0), (9, 1)):
+        errs = check_memory_provenance(
+            rec({"modes": {"m": {"analytic": "yes"}}}, rc=rc), lying_round)
+        assert any("analytic=" in e for e in errs), (lying_round, rc)
+    # non-record shapes and byte-free blocks stay silent
+    assert not check_memory_provenance({"rc": 0}, 9)
+    assert not check_memory_provenance(rec({"modes": {"m": {"x": 1}}}), 9)
+
+
+def test_bench_memory_block_carries_analytic_flag():
+    """bench.py's memory_footprint_8dev emission stamps analytic: True at
+    the block AND per-mode level (string-level pin, like the measured
+    flag's) — the provenance rule above would reject the block without
+    them from MEMORY_PROVENANCE_SINCE on."""
+    with open(os.path.join(REPO, "bench.py")) as fh:
+        src = fh.read()
+    assert '"analytic": True' in src
+    assert "memory_footprint_8dev" in src
